@@ -1,0 +1,35 @@
+"""distributed_membership_tpu — a TPU-native gossip-membership framework.
+
+A ground-up rebuild of the capabilities of patour/distributed-membership
+(the Coursera MP1 gossip-heartbeat membership protocol + EmulNet discrete-tick
+network simulator, reference mounted at /root/reference) designed for TPU
+hardware from the start:
+
+- the per-node protocol step (reference ``MP1Node::nodeLoop``, MP1Node.cpp:182)
+  becomes a single jitted tensor transition over an ``(N_nodes x member_view)``
+  state, run under ``lax.scan`` for a whole simulation with no per-tick host sync;
+- the message queue (reference ``EmulNet``, EmulNet.cpp) disappears: the LIST
+  gossip burst is semantically heartbeat-max propagation over a random K-fanout
+  graph, implemented as masked scatter-max on one chip and ring reduce-max /
+  ``all_to_all`` over ICI when the node axis is sharded across a mesh;
+- the tick driver (reference ``Application::run``, Application.cpp:90) survives
+  as a thin host loop that selects a backend via the ``BACKEND:`` config key
+  while keeping the reference's ``.conf`` format and ``dbg.log`` event-log
+  contract, so the original grader checks pass unchanged at N=10.
+
+Layout:
+    config         Params / .conf parsing (reference Params.{h,cpp})
+    addressing     Address model (reference Member.h:29-55)
+    eventlog       dbg.log / stats.log writer (reference Log.{h,cpp})
+    grader         Python port of the grading oracle (Grader_verbose.sh)
+    backends/      'emul' (faithful queue semantics) and 'tpu' (vectorized)
+    ops/           merge / sampling kernels
+    parallel/      mesh + collectives (ppermute ring reduce-max, sharded step)
+    runtime/       tick engine, failure injection, CLI
+    observability/ msgcount counters + dump (reference EmulNet.cpp:184-218)
+    native/        C++ host simulator core (accelerated emul backend)
+"""
+
+__version__ = "0.1.0"
+
+from distributed_membership_tpu.config import Params  # noqa: F401

@@ -1,0 +1,4 @@
+from distributed_membership_tpu.runtime.application import main
+import sys
+
+sys.exit(main())

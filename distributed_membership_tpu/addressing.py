@@ -1,0 +1,41 @@
+"""Address model.
+
+The reference packs a node address into 6 bytes — a little-endian int32 id and
+an int16 port (Member.h:29-55) — and prints it as ``b0.b1.b2.b3:port``
+(Log.cpp:73).  EmulNet assigns ids sequentially from 1 and forces port 0
+(EmulNet.cpp:72-77), so node index i has id i+1 and every address renders as
+``"<i+1 mod 256>.<...>:0"``.
+
+We keep plain integer ids everywhere (the D5 defect in the reference — strcmp
+on binary addresses, EmulNet.cpp:154 — came from treating the packed bytes as
+a C string; an integer key has no such aliasing) and only materialize the
+dotted string at the logging boundary.
+"""
+
+from __future__ import annotations
+
+
+def addr_str(node_id: int, port: int = 0) -> str:
+    """Dotted form of a packed little-endian id, e.g. id=1 -> '1.0.0.0:0'.
+
+    Matches Log.cpp:73's byte-wise rendering for any id, including ids > 255
+    which the reference would print as multi-byte dotted quads.
+    """
+    b0 = node_id & 0xFF
+    b1 = (node_id >> 8) & 0xFF
+    b2 = (node_id >> 16) & 0xFF
+    b3 = (node_id >> 24) & 0xFF
+    return f"{b0}.{b1}.{b2}.{b3}:{port}"
+
+
+def index_to_id(i: int) -> int:
+    """Node index (0-based) to EmulNet-assigned id (1-based), EmulNet.cpp:74."""
+    return i + 1
+
+
+def id_to_index(node_id: int) -> int:
+    return node_id - 1
+
+
+INTRODUCER_ID = 1  # Application::getjoinaddr / MP1Node::getJoinAddress: id 1, port 0
+INTRODUCER_INDEX = 0

@@ -1,0 +1,78 @@
+"""Backend registry.
+
+A backend turns a :class:`~distributed_membership_tpu.config.Params` into a
+completed simulation: an :class:`~distributed_membership_tpu.eventlog.EventLog`
+full of grader-visible events plus message counters.  The ``BACKEND:`` config
+key selects one (the rebuild extension called out in BASELINE.json), replacing
+the reference's single hardwired EmulNet path (Application.cpp:53).
+
+Backends:
+  * ``emul``        — faithful queue-level host simulator (executable spec);
+  * ``emul_native`` — same semantics, C++ core via ctypes;
+  * ``tpu``         — dense vectorized jitted step under ``lax.scan``;
+  * ``tpu_sharded`` — node axis sharded over a device mesh (shard_map);
+  * ``tpu_sparse``  — bounded member views for large N (hash-slotted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+
+
+@dataclasses.dataclass
+class RunResult:
+    """Everything a completed run produces.
+
+    ``sent``/``recv`` are ``[N, T]`` int arrays mirroring the reference's
+    ``sent_msgs``/``recv_msgs`` matrices (EmulNet.h:83-84) — the reference's
+    only profiler, dumped to msgcount.log at shutdown (EmulNet.cpp:184-218).
+    """
+
+    params: Params
+    log: EventLog
+    sent: np.ndarray
+    recv: np.ndarray
+    failed_indices: List[int]
+    fail_time: Optional[int]
+    wall_seconds: float = 0.0
+    extra: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+BackendFn = Callable[..., RunResult]
+
+_REGISTRY: Dict[str, BackendFn] = {}
+
+
+def register(name: str):
+    def deco(fn: BackendFn) -> BackendFn:
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+_MODULES = {
+    "emul": "distributed_membership_tpu.backends.emul",
+    "emul_native": "distributed_membership_tpu.backends.emul_native",
+    "tpu": "distributed_membership_tpu.backends.tpu",
+    "tpu_sharded": "distributed_membership_tpu.backends.tpu_sharded",
+    "tpu_sparse": "distributed_membership_tpu.backends.tpu_sparse",
+}
+
+
+def get_backend(name: str) -> BackendFn:
+    # Import lazily so that e.g. the emul backend works without jax present.
+    if name not in _REGISTRY:
+        import importlib
+        try:
+            importlib.import_module(_MODULES[name])
+        except (ImportError, KeyError) as e:
+            raise NotImplementedError(
+                f"backend {name!r} is not available "
+                f"(known: {sorted(_MODULES)})") from e
+    return _REGISTRY[name]

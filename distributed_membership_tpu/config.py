@@ -1,0 +1,170 @@
+"""Params: configuration + simulation clock.
+
+Rebuild of the reference's ``Params`` (Params.h:23-33, Params.cpp:19-50).
+The reference fscanf's exactly four keys from a ``.conf`` file
+(``MAX_NNB, SINGLE_FAILURE, DROP_MSG, MSG_DROP_PROB``, Params.cpp:22-25) and
+derives ``EN_GPSZ = MAX_NNB``, ``STEP_RATE = .25``, ``MAX_MSG_SIZE = 4000``
+(Params.cpp:29-31).  This parser accepts those files byte-for-byte and extends
+the format with optional ``KEY: value`` lines (notably ``BACKEND:``) while
+remaining ignorable by the reference's fscanf (extensions go after the four
+legacy keys).
+
+Like the reference, Params doubles as the global simulation clock:
+``getcurrtime()`` returns ``globaltime`` which the driver increments
+(Application.cpp:99, Params.cpp:48-50).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# Reference compile-time constants, kept as defaults but made configurable
+# (MP1Node.h:21-22, Application.h:27, MP1Node.cpp:456, EmulNet.h:10-12).
+DEFAULT_TFAIL = 5
+DEFAULT_TREMOVE = 20
+DEFAULT_TOTAL_TIME = 700
+DEFAULT_FANOUT = 5
+DEFAULT_EN_BUFFSIZE = 30000
+DEFAULT_PORTNUM = 8001  # Params.cpp:12 (unused for addressing: ENinit forces port 0)
+
+_KNOWN_BACKENDS = ("emul", "emul_native", "tpu", "tpu_sharded", "tpu_sparse")
+
+
+@dataclasses.dataclass
+class Params:
+    """All simulation knobs plus the global clock.
+
+    Field groups:
+      * legacy .conf keys — identical meaning to Params.h:23-28;
+      * derived values — same derivations as Params.cpp:29-34;
+      * extensions — new keys for the TPU rebuild (backend select, seed,
+        scale, protocol constants that were #defines in the reference).
+    """
+
+    # --- legacy keys (Params.cpp:22-25) ---
+    MAX_NNB: int = 10
+    SINGLE_FAILURE: int = 1
+    DROP_MSG: int = 0
+    MSG_DROP_PROB: float = 0.0
+
+    # --- derived (Params.cpp:29-34) ---
+    EN_GPSZ: int = 10          # == MAX_NNB
+    STEP_RATE: float = 0.25
+    MAX_MSG_SIZE: int = 4000
+    globaltime: int = 0
+    dropmsg: int = 0
+
+    # --- constants promoted from #defines ---
+    PORTNUM: int = DEFAULT_PORTNUM
+    TFAIL: int = DEFAULT_TFAIL
+    TREMOVE: int = DEFAULT_TREMOVE
+    TOTAL_TIME: int = DEFAULT_TOTAL_TIME
+    FANOUT: int = DEFAULT_FANOUT
+    EN_BUFFSIZE: int = DEFAULT_EN_BUFFSIZE
+
+    # --- rebuild extensions ---
+    BACKEND: str = "emul"
+    SEED: int = 0
+    # JOIN_MODE 'staggered' reproduces the reference's t == int(STEP_RATE*i)
+    # introduction schedule (Application.cpp:143); 'batch' starts every node at
+    # t=0 (introducer at t=0, joiners send JOINREQ at t=0) for scale runs.
+    JOIN_MODE: str = "staggered"
+    # Failure-injection schedule (reference hardcodes these: Application.cpp:177-200).
+    FAIL_TIME: int = 100
+    DROP_START: int = 50
+    DROP_STOP: int = 300
+    # Bounded member view (0 = full list). The spec explicitly permits a
+    # partial fixed-size list; this is the 1M-node scaling mechanism.
+    VIEW_SIZE: int = 0
+    # Entries piggybacked per gossip message in the sparse backend.
+    GOSSIP_LEN: int = 0  # 0 = whole view
+    # Correlated failure injection for scale scenarios: fail RACK_FAILURES
+    # whole racks of RACK_SIZE contiguous nodes at FAIL_TIME.
+    RACK_SIZE: int = 0
+    RACK_FAILURES: int = 0
+
+    def getcurrtime(self) -> int:
+        """Time since start of run, in ticks (Params.cpp:48-50)."""
+        return self.globaltime
+
+    # ------------------------------------------------------------------
+    def setparams(self, config_file: str) -> "Params":
+        """Parse a .conf file (legacy 4-key format + extensions).
+
+        Mirrors Params::setparams (Params.cpp:19-40): reads the four legacy
+        keys, then derives EN_GPSZ / STEP_RATE / MAX_MSG_SIZE and zeroes the
+        clock. Any further ``KEY: value`` lines set extension fields.
+        """
+        with open(config_file, "r") as fh:
+            text = fh.read()
+        self.parse(text)
+        return self
+
+    def parse(self, text: str) -> "Params":
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"([A-Za-z_][A-Za-z0-9_]*)\s*:\s*(.*)", line)
+            if not m:
+                continue
+            key, raw = m.group(1), m.group(2).strip()
+            self._set(key, raw)
+
+        # Derivations, as Params.cpp:29-34.
+        self.EN_GPSZ = self.MAX_NNB
+        self.globaltime = 0
+        self.dropmsg = 0
+        self.validate()
+        return self
+
+    def _set(self, key: str, raw: str) -> None:
+        if not hasattr(self, key):
+            # Unknown keys are ignored (forward compatibility), matching the
+            # reference's fscanf which simply never reads them.
+            return
+        cur = getattr(self, key)
+        if isinstance(cur, bool):
+            setattr(self, key, raw.lower() in ("1", "true", "yes"))
+        elif isinstance(cur, int):
+            setattr(self, key, int(raw))
+        elif isinstance(cur, float):
+            setattr(self, key, float(raw))
+        else:
+            setattr(self, key, raw)
+
+    def validate(self) -> None:
+        if self.BACKEND not in _KNOWN_BACKENDS:
+            raise ValueError(
+                f"BACKEND must be one of {_KNOWN_BACKENDS}, got {self.BACKEND!r}"
+            )
+        if self.EN_GPSZ < 1:
+            raise ValueError("MAX_NNB must be >= 1")
+        if self.JOIN_MODE not in ("staggered", "batch"):
+            raise ValueError(f"JOIN_MODE must be staggered|batch, got {self.JOIN_MODE!r}")
+        # Heartbeats advance by +2 per tick (reference double increment,
+        # MP1Node.cpp:412-414). int32 state is safe iff 2*TOTAL_TIME fits;
+        # the TPU backends use int32 — make the bound explicit rather than
+        # silently overflowing (SURVEY.md hard-part #5).
+        if 2 * self.TOTAL_TIME >= 2**31:
+            raise ValueError("TOTAL_TIME too large for int32 heartbeats")
+
+    # ------------------------------------------------------------------
+    def start_tick(self, i: int) -> int:
+        """Tick at which node index i is introduced.
+
+        Reference: node i starts when ``getcurrtime() == (int)(STEP_RATE*i)``
+        (Application.cpp:143); with STEP_RATE=.25 that is i//4.
+        """
+        if self.JOIN_MODE == "batch":
+            return 0
+        return int(self.STEP_RATE * i)
+
+    @classmethod
+    def from_file(cls, config_file: str) -> "Params":
+        return cls().setparams(config_file)
+
+    @classmethod
+    def from_text(cls, text: str) -> "Params":
+        return cls().parse(text)

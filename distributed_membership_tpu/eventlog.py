@@ -1,0 +1,107 @@
+"""Grader-facing event log: the dbg.log / stats.log contract.
+
+Rebuild of the reference ``Log`` class (Log.{h,cpp}).  The grading oracle
+(Grader_verbose.sh) greps ``dbg.log`` for ``joined`` / ``removed`` /
+``Node failed at time`` lines, so this file *is* the compatibility surface.
+Byte format replicated from Log.cpp:
+
+  * first line: the magic number — hex of the character sum of "CS425"
+    (Log.cpp:79-88), i.e. ``131``;
+  * each entry: ``"\\n <addr> [<time>] <message>"`` — note the leading space
+    before the address (Log.cpp:97-99: ``fprintf(fp, "\\n %s", stdstring)``
+    where stdstring carries a trailing space, then ``"[%d] "`` then the body);
+  * messages prefixed ``#STATSLOG#`` are routed to stats.log instead
+    (Log.cpp:90-95);
+  * event line bodies: ``Node <addr> joined at time <t>`` (Log.cpp:118) and
+    ``Node <addr> removed at time <t>`` (Log.cpp:129).
+
+Defect D1 (static 30-char buffer overflow truncating the log, Log.cpp:117-118)
+is structurally impossible here.  Unlike the reference, which flushes every
+line (MAXWRITES=1, Log.h:18), we buffer in memory and flush on close — the
+TPU backends emit events in bulk after a ``lax.scan``, so per-line flushing
+would be pure overhead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from distributed_membership_tpu.addressing import addr_str
+
+MAGIC_SOURCE = "CS425"  # Log.h:19
+DBG_LOG = "dbg.log"     # Log.h:21
+STATS_LOG = "stats.log"  # Log.h:22
+STATS_PREFIX = "#STATSLOG#"
+
+
+def magic_line() -> str:
+    """Hex char-sum of the magic string, '131' for CS425 (Log.cpp:79-88)."""
+    return format(sum(ord(c) for c in MAGIC_SOURCE), "x")
+
+
+def format_entry(addr: str, time: int, message: str) -> str:
+    """One log entry exactly as Log.cpp:97-99 emits it."""
+    return f"\n {addr} [{time}] {message}"
+
+
+def joined_message(added_addr: str, time: int) -> str:
+    return f"Node {added_addr} joined at time {time}"  # Log.cpp:118
+
+
+def removed_message(removed_addr: str, time: int) -> str:
+    return f"Node {removed_addr} removed at time {time}"  # Log.cpp:129
+
+
+class EventLog:
+    """In-memory accumulator for the dbg.log / stats.log channels."""
+
+    def __init__(self, directory: str = "."):
+        self.directory = directory
+        self._dbg: List[str] = []
+        self._stats: List[str] = []
+        self._wrote_magic = False
+
+    # -- primitive, mirrors Log::LOG (Log.cpp:44-109) --------------------
+    def log(self, node_id: int, time: int, message: str, port: int = 0) -> None:
+        if not self._wrote_magic:
+            self._dbg.append(magic_line() + "\n")
+            self._wrote_magic = True
+        entry = format_entry(addr_str(node_id, port), time, message)
+        if message.startswith(STATS_PREFIX):
+            self._stats.append(entry)
+        else:
+            self._dbg.append(entry)
+
+    # -- event helpers, mirror logNodeAdd / logNodeRemove -----------------
+    def node_add(self, logger_id: int, added_id: int, time: int) -> None:
+        self.log(logger_id, time, joined_message(addr_str(added_id), time))
+
+    def node_remove(self, logger_id: int, removed_id: int, time: int) -> None:
+        self.log(logger_id, time, removed_message(addr_str(removed_id), time))
+
+    def node_failed_single(self, failed_id: int, time: int) -> None:
+        # Application.cpp:184 — no spaces around '='.
+        self.log(failed_id, time, f"Node failed at time={time}")
+
+    def node_failed_multi(self, failed_id: int, time: int) -> None:
+        # Application.cpp:192 — spaces around '='.
+        self.log(failed_id, time, f"Node failed at time = {time}")
+
+    # ---------------------------------------------------------------------
+    def dbg_text(self) -> str:
+        return "".join(self._dbg)
+
+    def stats_text(self) -> str:
+        return "".join(self._stats)
+
+    def flush(self, directory: Optional[str] = None) -> str:
+        """Write dbg.log and stats.log; returns the dbg.log path."""
+        directory = directory or self.directory
+        os.makedirs(directory, exist_ok=True)
+        dbg_path = os.path.join(directory, DBG_LOG)
+        with open(dbg_path, "w") as fh:
+            fh.write(self.dbg_text())
+        with open(os.path.join(directory, STATS_LOG), "w") as fh:
+            fh.write(self.stats_text())
+        return dbg_path

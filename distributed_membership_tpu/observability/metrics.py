@@ -1,0 +1,61 @@
+"""Message counters and the msgcount.log dump.
+
+The reference's only profiler: per-(node, tick) send/receive count matrices
+(EmulNet.h:83-84, incremented at EmulNet.cpp:111,172) dumped at shutdown in a
+fixed text format (EmulNet::ENcleanup, EmulNet.cpp:189-218).  Every backend
+carries these counters — as numpy arrays on the host path and as int32
+tensors in the scan state on the TPU paths — and this writer reproduces the
+dump format, including the reference's odd special-casing of node 67
+(EmulNet.cpp:210-212).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def write_msgcount(result, out_dir: str = ".") -> str:
+    """Dump sent/recv matrices in the EmulNet.cpp:189-218 format."""
+    sent, recv = result.sent, result.recv
+    n, total = sent.shape
+    path = os.path.join(out_dir, "msgcount.log")
+    chunks = []
+    for i in range(n):
+        node_id = i + 1
+        chunks.append(f"node {node_id:3d} ")
+        sent_total = int(sent[i].sum())
+        recv_total = int(recv[i].sum())
+        if node_id != 67:
+            for j in range(total):
+                chunks.append(f" ({int(sent[i, j]):4d}, {int(recv[i, j]):4d})")
+                if j % 10 == 9:
+                    chunks.append("\n         ")
+        else:
+            for j in range(total):
+                chunks.append(f"special {j:4d} {int(sent[i, j]):4d} {int(recv[i, j]):4d}\n")
+        chunks.append("\n")
+        chunks.append(f"node {node_id:3d} sent_total {sent_total:6d}  recv_total {recv_total:6d}\n\n")
+    with open(path, "w") as fh:
+        fh.write("".join(chunks))
+    return path
+
+
+def removal_latencies(dbg_text: str, fail_time: int):
+    """Detection latency distribution: ticks from failure to each logged
+    removal of a failed node.  The parity metric BASELINE.md tracks
+    (reference measures 21-22 single / 21-23 multi)."""
+    failed_addrs = set()
+    lats = []
+    for line in dbg_text.splitlines():
+        if "Node failed at time" in line:
+            failed_addrs.add(line.split()[0])
+    for line in dbg_text.splitlines():
+        if "removed" not in line:
+            continue
+        parts = line.split()
+        # " <logger> [t] Node <addr> removed at time <t>"
+        removed_addr = parts[3]
+        if removed_addr in failed_addrs:
+            t = int(parts[1].strip("[]"))
+            lats.append(t - fail_time)
+    return lats

@@ -1,0 +1,76 @@
+"""Failure injection plans.
+
+The reference injects failures inline in the driver (``Application::fail``,
+Application.cpp:173-202): crash-stop of one random node (SINGLE_FAILURE) or of
+``EN_GPSZ/2`` contiguous nodes at t=100, plus a message-drop window
+``dropmsg=1`` for t in [50, 300) when DROP_MSG is set (consumed by the network
+send path, EmulNet.cpp:90-94).  Failed nodes never recover — ``bFailed`` is
+never reset and there is no LEAVE message (SURVEY.md §5).
+
+Here the plan is computed up front from the seeded RNG so every backend —
+including the jitted TPU step, which needs the schedule as tensors — injects
+the *same* failures for the same seed.  An extension adds correlated rack
+failures for scale scenarios (BASELINE.json config #4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import List, Optional
+
+from distributed_membership_tpu.config import Params
+
+
+@dataclasses.dataclass
+class FailurePlan:
+    kind: str                    # 'single' | 'multi' | 'racks' | 'none'
+    fail_time: Optional[int]
+    failed_indices: List[int]    # node indices (0-based) crashed at fail_time
+    drop_start: Optional[int]    # tick when dropmsg flips on (None if never)
+    drop_stop: Optional[int]
+
+
+def make_plan(params: Params, rng: random.Random) -> FailurePlan:
+    n = params.EN_GPSZ
+    drop_start = params.DROP_START if params.DROP_MSG else None
+    drop_stop = params.DROP_STOP if params.DROP_MSG else None
+
+    if params.RACK_SIZE > 0 and params.RACK_FAILURES > 0:
+        # Correlated rack failures: RACK_FAILURES distinct racks of RACK_SIZE
+        # contiguous nodes all crash at FAIL_TIME.
+        n_racks = max(n // params.RACK_SIZE, 1)
+        racks = rng.sample(range(n_racks), min(params.RACK_FAILURES, n_racks))
+        failed = [
+            i
+            for r in racks
+            for i in range(r * params.RACK_SIZE,
+                           min((r + 1) * params.RACK_SIZE, n))
+        ]
+        return FailurePlan("racks", params.FAIL_TIME, sorted(failed),
+                           drop_start, drop_stop)
+
+    if params.SINGLE_FAILURE:
+        # Application.cpp:182: removed = rand() % EN_GPSZ.
+        failed = [rng.randrange(n)]
+        return FailurePlan("single", params.FAIL_TIME, failed,
+                           drop_start, drop_stop)
+
+    # Application.cpp:189: removed = rand() % EN_GPSZ / 2 (C precedence:
+    # (rand() % N) / 2), then the N/2 contiguous nodes from there fail.
+    start = rng.randrange(n) // 2
+    failed = list(range(start, min(start + n // 2, n)))
+    return FailurePlan("multi", params.FAIL_TIME, failed,
+                       drop_start, drop_stop)
+
+
+def log_failures(plan: FailurePlan, log, t: int) -> None:
+    """Emit the 'Node failed at time...' lines exactly as Application.cpp:184,192."""
+    from distributed_membership_tpu.addressing import index_to_id
+    if plan.fail_time != t:
+        return
+    if plan.kind == "single":
+        log.node_failed_single(index_to_id(plan.failed_indices[0]), t)
+    else:
+        for i in plan.failed_indices:
+            log.node_failed_multi(index_to_id(i), t)

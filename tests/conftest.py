@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+TPU-path tests run on a virtual 8-device CPU mesh: multi-chip hardware is not
+available in CI, so sharding correctness is validated with
+``xla_force_host_platform_device_count`` (the standard JAX trick), while the
+single-chip path runs on whatever platform is present.  Must be set before
+jax is first imported.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="session")
+def testcases_dir():
+    return REPO / "testcases"

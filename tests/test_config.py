@@ -1,0 +1,50 @@
+import pytest
+
+from distributed_membership_tpu.config import Params
+
+
+def test_legacy_conf_parsing(testcases_dir):
+    p = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    assert p.MAX_NNB == 10
+    assert p.SINGLE_FAILURE == 1
+    assert p.DROP_MSG == 0
+    assert p.MSG_DROP_PROB == pytest.approx(0.1)
+    # Derivations (Params.cpp:29-34).
+    assert p.EN_GPSZ == 10
+    assert p.STEP_RATE == 0.25
+    assert p.MAX_MSG_SIZE == 4000
+    assert p.globaltime == 0
+    assert p.dropmsg == 0
+    # Defaults for promoted #defines.
+    assert (p.TFAIL, p.TREMOVE, p.TOTAL_TIME, p.FANOUT) == (5, 20, 700, 5)
+    assert p.BACKEND == "emul"
+
+
+def test_extension_keys():
+    p = Params.from_text(
+        "MAX_NNB: 64\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0.0\n"
+        "BACKEND: tpu\nSEED: 42\nTOTAL_TIME: 100\nJOIN_MODE: batch\nVIEW_SIZE: 16\n")
+    assert p.EN_GPSZ == 64
+    assert p.BACKEND == "tpu"
+    assert p.SEED == 42
+    assert p.TOTAL_TIME == 100
+    assert p.JOIN_MODE == "batch"
+    assert p.VIEW_SIZE == 16
+
+
+def test_unknown_keys_ignored():
+    p = Params.from_text("MAX_NNB: 5\nNOT_A_KEY: whatever\n")
+    assert p.EN_GPSZ == 5
+
+
+def test_bad_backend_rejected():
+    with pytest.raises(ValueError):
+        Params.from_text("MAX_NNB: 5\nBACKEND: cuda\n")
+
+
+def test_start_tick_schedule():
+    # Node i starts at int(0.25*i) (Application.cpp:143).
+    p = Params.from_text("MAX_NNB: 10\n")
+    assert [p.start_tick(i) for i in range(10)] == [0, 0, 0, 0, 1, 1, 1, 1, 2, 2]
+    p.JOIN_MODE = "batch"
+    assert [p.start_tick(i) for i in range(10)] == [0] * 10

@@ -1,0 +1,75 @@
+"""End-to-end scenario tests for the faithful host backend.
+
+Mirrors the reference's test strategy (SURVEY.md §4): no unit-level protocol
+tests existed upstream — the whole contract is "run a scenario, grep the
+log" — so these tests run the three shipped scenarios and apply the ported
+grading oracle, then additionally check the measured reference behaviors from
+BASELINE.md (join convergence by tick 5, removal latency 21-23 ticks).
+"""
+
+import re
+
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import grade_scenario
+from distributed_membership_tpu.observability.metrics import removal_latencies
+
+
+def run_scenario(testcases_dir, name, seed=0):
+    params = Params.from_file(str(testcases_dir / f"{name}.conf"))
+    return get_backend("emul")(params, seed=seed)
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_scenario_passes_grader(testcases_dir, scenario):
+    result = run_scenario(testcases_dir, scenario)
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
+
+
+def test_join_convergence(testcases_dir):
+    # All 10 nodes mutually joined by tick 5 (BASELINE.md, measured).
+    result = run_scenario(testcases_dir, "singlefailure")
+    join_times = [int(m.group(1))
+                  for m in re.finditer(r"\[(\d+)\] Node [\d.:]+ joined", result.log.dbg_text())]
+    assert len(join_times) == 99  # 10x9 pairs + 9 self-adds via gossip
+    assert max(join_times) <= 5
+
+
+@pytest.mark.parametrize("scenario,expected_count", [
+    ("singlefailure", 9), ("multifailure", 25), ("msgdropsinglefailure", 9)])
+def test_removal_latency_distribution(testcases_dir, scenario, expected_count):
+    # Reference measured: 21-22 ticks (single), 21-23 (multi) after t=100 crash.
+    result = run_scenario(testcases_dir, scenario)
+    lats = removal_latencies(result.log.dbg_text(), result.fail_time)
+    assert len(lats) == expected_count
+    assert all(20 <= l <= 24 for l in lats), sorted(lats)
+
+
+def test_message_volume_matches_reference(testcases_dir):
+    # Reference measured ~286k msgs for singlefailure, ~121k for multifailure
+    # (BASELINE.md). Distributional check with generous tolerance.
+    single = run_scenario(testcases_dir, "singlefailure")
+    multi = run_scenario(testcases_dir, "multifailure")
+    assert 240_000 < single.sent.sum() < 330_000
+    assert 90_000 < multi.sent.sum() < 150_000
+
+
+def test_counters_shape_and_conservation(testcases_dir):
+    result = run_scenario(testcases_dir, "singlefailure")
+    assert result.sent.shape == (10, 700)
+    # Every received message was sent; some sent messages are never received
+    # (those addressed to the crashed node sit in the buffer forever).
+    assert result.recv.sum() <= result.sent.sum()
+    assert result.sent.sum() - result.recv.sum() < 3000
+
+
+def test_seed_reproducibility(testcases_dir):
+    a = run_scenario(testcases_dir, "singlefailure", seed=7)
+    b = run_scenario(testcases_dir, "singlefailure", seed=7)
+    assert a.log.dbg_text() == b.log.dbg_text()
+    c = run_scenario(testcases_dir, "singlefailure", seed=8)
+    assert a.log.dbg_text() != c.log.dbg_text()

@@ -1,0 +1,43 @@
+from distributed_membership_tpu.addressing import addr_str
+from distributed_membership_tpu.eventlog import EventLog, magic_line
+
+
+def test_magic_line_is_131():
+    # hex char-sum of "CS425" (Log.cpp:79-88).
+    assert magic_line() == "131"
+
+
+def test_addr_str_formats():
+    assert addr_str(1) == "1.0.0.0:0"
+    assert addr_str(10) == "10.0.0.0:0"
+    assert addr_str(256) == "0.1.0.0:0"  # little-endian byte rendering
+    assert addr_str(3, port=8001) == "3.0.0.0:8001"
+
+
+def test_entry_format_matches_reference():
+    log = EventLog()
+    log.log(1, 0, "APP")
+    log.node_add(1, 2, 5)
+    log.node_remove(3, 2, 121)
+    text = log.dbg_text()
+    # First line: magic; entries begin with "\n <addr> [t] ".
+    assert text.startswith("131\n")
+    assert "\n 1.0.0.0:0 [0] APP" in text
+    assert "\n 1.0.0.0:0 [5] Node 2.0.0.0:0 joined at time 5" in text
+    assert "\n 3.0.0.0:0 [121] Node 2.0.0.0:0 removed at time 121" in text
+
+
+def test_stats_channel_routing():
+    log = EventLog()
+    log.log(1, 3, "#STATSLOG# something")
+    assert "#STATSLOG#" in log.stats_text()
+    assert "something" not in log.dbg_text()
+
+
+def test_failed_line_formats():
+    log = EventLog()
+    log.node_failed_single(4, 100)
+    log.node_failed_multi(5, 100)
+    text = log.dbg_text()
+    assert "Node failed at time=100" in text      # Application.cpp:184
+    assert "Node failed at time = 100" in text    # Application.cpp:192

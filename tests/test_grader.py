@@ -1,0 +1,62 @@
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.grader import grade_multi, grade_single
+
+
+def synth_log(n=10, failed=(2,), removers_per_failed=None, extra_removed=()):
+    """Build a synthetic dbg.log: full join matrix + removal events."""
+    log = EventLog()
+    ids = list(range(1, n + 1))
+    for logger in ids:
+        for other in ids:
+            if other != logger:
+                log.node_add(logger, other, 5)
+    for f in failed:
+        log.node_failed_single(f, 100) if len(failed) == 1 else log.node_failed_multi(f, 100)
+    survivors = [i for i in ids if i not in failed]
+    for f in failed:
+        rs = survivors if removers_per_failed is None else survivors[:removers_per_failed]
+        for s in rs:
+            log.node_remove(s, f, 121)
+    for (logger, victim) in extra_removed:
+        log.node_remove(logger, victim, 130)
+    return log.dbg_text()
+
+
+def test_single_all_good():
+    g = grade_single(synth_log(), 10)
+    assert g.passed and g.points == 30
+
+
+def test_single_incomplete_detection():
+    g = grade_single(synth_log(removers_per_failed=5), 10)
+    assert g.join_ok and g.completeness_pts == 0
+
+
+def test_single_false_positive_breaks_accuracy():
+    g = grade_single(synth_log(extra_removed=[(3, 4)]), 10)
+    assert g.completeness_pts == 10 and g.accuracy_pts == 0
+
+
+def test_multi_scoring():
+    g = grade_multi(synth_log(failed=(4, 5, 6, 7, 8)), 10)
+    assert g.passed, g.details
+    assert g.completeness_pts == 10 and g.accuracy_pts == 10
+
+
+def test_join_fallback_path():
+    # 99 'joined' lines (no self-join for one node) must still pass via the
+    # per-logger fallback (Grader_verbose.sh:46-55) — the reference itself
+    # passes this way.
+    log = EventLog()
+    ids = list(range(1, 11))
+    for logger in ids:
+        for other in ids:
+            if other != logger:
+                log.node_add(logger, other, 5)
+    for logger in ids[1:]:  # self-joins for all but the introducer
+        log.node_add(logger, logger, 5)
+    g = grade_single(log.dbg_text() +
+                     "\n 2.0.0.0:0 [100] Node failed at time=100" +
+                     "".join(f"\n {i}.0.0.0:0 [121] Node 2.0.0.0:0 removed at time 121"
+                             for i in [1, 3, 4, 5, 6, 7, 8, 9, 10]), 10)
+    assert g.passed
