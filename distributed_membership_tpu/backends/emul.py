@@ -233,7 +233,8 @@ class EmulNode:
                     self.log.node_remove(self.id, e[0], t)
                     continue
             kept.append(e)
-        kept.sort(key=_entry_key)
+        # (filtering a sorted list preserves order — no re-sort needed, unlike
+        # the reference whose swap-remove shuffles and re-sorts at :446)
         self.members = members = kept
 
         # Gossip target selection (MP1Node.cpp:449-489): start from this

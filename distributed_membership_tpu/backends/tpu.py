@@ -1,0 +1,353 @@
+"""`tpu` backend: the protocol as one jitted tensor transition.
+
+The entire reference hot path — ENrecv buffer scans, per-message list merges,
+the TFAIL/TREMOVE sweep, gossip sends (SURVEY.md §3.2's four hot loops) —
+fuses into a single pure function ``step(state, t)`` over dense
+``[N, N]`` tensors, run under ``lax.scan`` for the whole simulation with no
+per-tick host synchronization.  Event extraction (the joined/removed log
+lines the grader reads) happens host-side afterwards by scanning the stacked
+per-tick event tensors.
+
+Why this is *exactly* (not approximately) the reference protocol, tick for
+tick: the receiver-side merge keeps the max heartbeat per entry and refreshes
+the local timestamp only on strict increase (MP1Node.cpp:278-288) — a
+commutative, associative combine — and cross-node interaction happens only
+through the 1-tick-latency message buffer (messages sent in pass 2 of tick t
+are received in pass 1 of tick t+1, Application.cpp:121-164).  Hence the
+reference's sequential per-node processing order within a tick is
+unobservable in the state, and a synchronous-parallel tensor step computes
+the identical state trajectory.  The only divergences are RNG draws (seeded
+jax.random here vs the reference's random_device mt19937, MP1Node.cpp:450)
+and log-line ordering — both checked distributionally against the `emul`
+backend (tests/test_tpu_backend.py).
+
+Structure-of-arrays state, one row per node:
+  present/hb/ts [N,N]  — member list as a dense table indexed by node id
+                         (id i+1 ↔ column i); heartbeats int32 (justified
+                         downcast from the reference's long: +2/tick for
+                         TOTAL_TIME ticks, bound checked in Params.validate)
+  infl_*        [N,N]  — in-flight messages, max-aggregated per receiver —
+                         this *is* EmulNet's buffer, reduced eagerly; entries
+                         addressed to not-yet-receiving nodes accumulate
+                         losslessly under max (join staggering, dead nodes)
+  joinreq/joinrep [N]  — the join handshake (MP1Node.cpp:126-163,226-251)
+  pending_recv  [N]    — queued message counts for the recv_msgs profile
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _pyrandom
+import time as _time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.ops.merge import fanout_deliver
+from distributed_membership_tpu.ops.sampling import sample_k_distinct
+from distributed_membership_tpu.runtime.failures import FailurePlan, log_failures, make_plan
+
+I32 = jnp.int32
+
+
+class State(NamedTuple):
+    present: jax.Array      # [N,N] bool
+    hb: jax.Array           # [N,N] i32
+    ts: jax.Array           # [N,N] i32
+    started: jax.Array      # [N] bool
+    in_group: jax.Array     # [N] bool
+    failed: jax.Array       # [N] bool
+    self_hb: jax.Array      # [N] i32
+    infl_has: jax.Array     # [N,N] bool
+    infl_hb: jax.Array      # [N,N] i32
+    joinreq_infl: jax.Array  # [N] bool — JOINREQ awaiting the introducer
+    joinrep_infl: jax.Array  # [N] bool — JOINREP awaiting the joiner
+    pending_recv: jax.Array  # [N] i32
+
+
+class TickEvents(NamedTuple):
+    joins: jax.Array        # [N,N] bool — logger i added entry j this tick
+    removes: jax.Array      # [N,N] bool
+    sent: jax.Array         # [N] i32
+    recv: jax.Array         # [N] i32
+
+
+@dataclasses.dataclass(frozen=True)
+class StepConfig:
+    """Static (compile-time) protocol constants."""
+    n: int
+    tfail: int
+    tremove: int
+    fanout: int
+    drop_prob: float        # effective int(p*100)/100, 0 disables drop code
+    collect_events: bool = True
+
+
+def init_state(n: int) -> State:
+    return State(
+        present=jnp.zeros((n, n), bool),
+        hb=jnp.zeros((n, n), I32),
+        ts=jnp.zeros((n, n), I32),
+        started=jnp.zeros((n,), bool),
+        in_group=jnp.zeros((n,), bool),
+        failed=jnp.zeros((n,), bool),
+        self_hb=jnp.zeros((n,), I32),
+        infl_has=jnp.zeros((n, n), bool),
+        infl_hb=jnp.full((n, n), -1, I32),
+        joinreq_infl=jnp.zeros((n,), bool),
+        joinrep_infl=jnp.zeros((n,), bool),
+        pending_recv=jnp.zeros((n,), I32),
+    )
+
+
+def make_step(cfg: StepConfig):
+    """Build the per-tick transition.
+
+    The returned function has signature
+    ``step(state, (t, key, start_ticks, fail_mask, fail_time, drop_window))
+    -> (state, TickEvents)`` and is pure/jittable; dynamic per-run inputs
+    (schedules) are tensors so one compilation serves every seed/scenario of
+    the same shape.
+    """
+    n = cfg.n
+    idx = jnp.arange(n)
+    intro = INTRODUCER_INDEX
+
+    def step(state: State, inputs):
+        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        k_targets, k_drop, k_ctrl = jax.random.split(key, 3)
+
+        # Effective drop window: the emul driver flips dropmsg *after* pass 2
+        # of DROP_START and clears it after pass 2 of DROP_STOP, so sends are
+        # dropped for t in (DROP_START, DROP_STOP] (Application.cpp:177-179,
+        # 198-200 ordering within Application::run).
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+        # Control messages (JOINREQ/JOINREP) face the same Bernoulli drop as
+        # any send — EmulNet::ENsend makes no message-type distinction.  A
+        # dropped JOINREQ strands the joiner forever, as in the reference
+        # (sent exactly once, MP1Node.cpp:126-163); only reachable when the
+        # join schedule overlaps the drop window (large staggered N).
+        if cfg.drop_prob > 0.0:
+            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+                          & drop_active)
+        else:
+            ctrl_kept = jnp.ones((2, n), bool)
+
+        # ---- pass 1 + message handling: deliver in-flight, merge, join
+        # handshake (MP1Node::recvLoop + checkMessages; identical eligibility
+        # gates, Application.cpp:130,153) ----
+        recv_mask = state.started & (t > start_ticks) & ~state.failed
+        deliver = state.infl_has & recv_mask[:, None]
+        newly = deliver & ~state.present
+        upd = deliver & state.present & (state.infl_hb > state.hb)
+        present = state.present | newly
+        hb = jnp.where(newly | upd, state.infl_hb, state.hb)
+        ts = jnp.where(newly | upd, t, state.ts)
+        infl_has = state.infl_has & ~recv_mask[:, None]
+        infl_hb = jnp.where(recv_mask[:, None], -1, state.infl_hb)
+        join_events = newly
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        in_group = state.in_group | (state.joinrep_infl & recv_mask)
+        joinrep_infl = state.joinrep_infl & ~recv_mask
+
+        # JOINREQs reaching the introducer this tick: these joiners are
+        # guaranteed gossip targets ("newNodes", MP1Node.cpp:240-242,454)
+        # and each gets a JOINREP (MP1Node.cpp:246-250).
+        seeds = state.joinreq_infl & recv_mask[intro]
+        joinreq_infl = state.joinreq_infl & ~recv_mask[intro]
+        rep_ok = seeds & ctrl_kept[1]  # JOINREPs that survive the drop window
+        joinrep_infl = joinrep_infl | rep_ok
+        n_seeds = seeds.sum(dtype=I32)
+        sent_rep = jnp.where(idx == intro,
+                             jnp.where(recv_mask[intro], rep_ok.sum(dtype=I32), 0), 0)
+        pending_recv = pending_recv + rep_ok.astype(I32)
+
+        # ---- nodeStart (Application.cpp:143-148, MP1Node.cpp:73-163) ----
+        start_now = t == start_ticks
+        started = state.started | start_now
+        boot = start_now[intro]  # introducer boots the group
+        present = present.at[intro, intro].set(present[intro, intro] | boot)
+        hb = hb.at[intro, intro].set(jnp.where(boot, 0, hb[intro, intro]))
+        ts = ts.at[intro, intro].set(jnp.where(boot, t, ts[intro, intro]))
+        in_group = in_group.at[intro].set(in_group[intro] | boot)
+
+        joiner_req = start_now & (idx != intro) & ctrl_kept[0]
+        infl_has = infl_has.at[intro].set(infl_has[intro] | joiner_req)
+        infl_hb = infl_hb.at[intro].set(
+            jnp.where(joiner_req, jnp.maximum(infl_hb[intro], 0), infl_hb[intro]))
+        joinreq_infl = joinreq_infl | joiner_req
+        pending_recv = pending_recv.at[intro].add(joiner_req.sum(dtype=I32))
+        sent_req = joiner_req.astype(I32)
+
+        # ---- pass 2: nodeLoopOps (MP1Node.cpp:404-495) ----
+        act = started & (t > start_ticks) & ~state.failed & in_group
+
+        # Self refresh: the double heartbeat increment — own entry gets the
+        # odd intermediate value (MP1Node.cpp:412-415).
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        present = present.at[idx, idx].set(present[idx, idx] | act)
+        hb = hb.at[idx, idx].set(jnp.where(act, own_hb, hb[idx, idx]))
+        ts = ts.at[idx, idx].set(jnp.where(act, t, ts[idx, idx]))
+
+        # TFAIL / TREMOVE sweep (MP1Node.cpp:429-446).
+        difft = t - ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        present = present & ~removes
+
+        # Gossip target selection (MP1Node.cpp:449-489): sample a uniform
+        # k-subset of fresh non-self entries, k bounded by the reference's
+        # (quirky: post-removal size, pre-removal stale count) potential
+        # formula at MP1Node.cpp:463.
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        seed_burst = seeds & act[intro]
+        eligible = fresh & (idx[None, :] != idx[:, None]) & act[:, None]
+        eligible = eligible.at[intro].set(eligible[intro] & ~seed_burst)
+        n_seeds_row = jnp.where(idx == intro, jnp.where(act[intro], n_seeds, 0), 0)
+        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        target_mask = sample_k_distinct(k_targets, eligible, k_extra)
+        target_mask = target_mask.at[intro].set(target_mask[intro] | seed_burst)
+        target_mask = target_mask & act[:, None]
+
+        # Send: one message per (sender, target, live entry); stale entries
+        # withheld (MP1Node.cpp:376 — prevents failed-node resurrection).
+        send_hb = jnp.where(fresh, hb, -1)
+        contrib, sent_list, recv_add = fanout_deliver(
+            k_drop, target_mask, send_hb, drop_active, cfg.drop_prob)
+        infl_has = infl_has | (contrib >= 0)
+        infl_hb = jnp.maximum(infl_hb, contrib)
+        pending_recv = pending_recv + recv_add
+        sent_tick = sent_list + sent_req + sent_rep
+
+        # ---- failure injection, end of tick (Application::fail) ----
+        failed = state.failed | (fail_mask & (t == fail_time))
+
+        new_state = State(present, hb, ts, started, in_group, failed, self_hb,
+                          infl_has, infl_hb, joinreq_infl, joinrep_infl,
+                          pending_recv)
+        if cfg.collect_events:
+            out = TickEvents(join_events, removes, sent_tick, recv_tick)
+        else:
+            out = TickEvents(join_events.sum(dtype=I32),
+                             removes.sum(dtype=I32), sent_tick, recv_tick)
+        return new_state, out
+
+    return step
+
+
+def run_scan(params: Params, plan: FailurePlan, seed: int,
+             collect_events: bool = True, total_time: Optional[int] = None):
+    """Jit-compile and run the full simulation; returns (final_state, events)."""
+    n = params.EN_GPSZ
+    total = total_time if total_time is not None else params.TOTAL_TIME
+    cfg = StepConfig(
+        n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
+        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        collect_events=collect_events)
+    step = make_step(cfg)
+
+    start_ticks = jnp.asarray([params.start_tick(i) for i in range(n)], I32)
+    fail_mask = np.zeros((n,), bool)
+    fail_time = -1
+    if plan.fail_time is not None:
+        fail_mask[plan.failed_indices] = True
+        fail_time = plan.fail_time
+    drop_lo = plan.drop_start if plan.drop_start is not None else total + 1
+    drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
+
+    ticks = jnp.arange(total, dtype=I32)
+    keys = jax.vmap(lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
+
+    @jax.jit
+    def run(keys):
+        inputs = (ticks, keys,
+                  jnp.broadcast_to(start_ticks, (total, n)),
+                  jnp.broadcast_to(jnp.asarray(fail_mask), (total, n)),
+                  jnp.full((total,), fail_time, I32),
+                  jnp.full((total,), drop_lo, I32),
+                  jnp.full((total,), drop_hi, I32))
+        return jax.lax.scan(step, init_state(n), inputs)
+
+    final_state, events = run(keys)
+    return final_state, jax.tree.map(np.asarray, events)
+
+
+def events_to_log(params: Params, plan: FailurePlan, events: TickEvents,
+                  log: EventLog) -> None:
+    """Reconstruct the reference's dbg.log from stacked event tensors.
+
+    Emits the same line inventory as the reference run (SURVEY.md §4
+    log-format contract): APP lines, Starting up group / Trying to join,
+    joined/removed events, @@time beacons, failure notices.  Line order
+    within a tick differs from the reference's descending-node-order
+    interleaving; the grading oracle is order-insensitive (sort -u).
+    """
+    n = params.EN_GPSZ
+    total = events.joins.shape[0]
+    starts = [params.start_tick(i) for i in range(n)]
+    for i in range(n):
+        log.log(i + 1, 0, "APP")  # constructor lines (Application.cpp:67)
+
+    joins_t, joins_i, joins_j = np.nonzero(events.joins)
+    removes_t, removes_i, removes_j = np.nonzero(events.removes)
+    join_by_tick: dict = {}
+    for t, i, j in zip(joins_t, joins_i, joins_j):
+        join_by_tick.setdefault(int(t), []).append((int(i), int(j)))
+    remove_by_tick: dict = {}
+    for t, i, j in zip(removes_t, removes_i, removes_j):
+        remove_by_tick.setdefault(int(t), []).append((int(i), int(j)))
+
+    intro_failed = (plan.fail_time is not None
+                    and INTRODUCER_INDEX in plan.failed_indices)
+    for t in range(total):
+        for i in range(n - 1, -1, -1):
+            if starts[i] == t:
+                if i == INTRODUCER_INDEX:
+                    log.log(i + 1, t, "Starting up group...")
+                else:
+                    log.log(i + 1, t, "Trying to join...")
+        for i, j in join_by_tick.get(t, ()):
+            log.node_add(i + 1, j + 1, t)
+        for i, j in remove_by_tick.get(t, ()):
+            log.node_remove(i + 1, j + 1, t)
+        if (t % 500 == 0 and t > starts[INTRODUCER_INDEX]
+                and not (intro_failed and t > plan.fail_time)):
+            log.log(INTRODUCER_INDEX + 1, t, f"@@time={t}")  # Application.cpp:156-160
+        if plan.fail_time == t:
+            log_failures(plan, log, t)
+
+
+@register("tpu")
+def run_tpu(params: Params, log: Optional[EventLog] = None,
+            seed: Optional[int] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    # Same failure-plan RNG stream as the emul backend: identical seeds fail
+    # identical nodes, making runs directly comparable across backends.
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    final_state, events = run_scan(params, plan, seed)
+    events_to_log(params, plan, events, log)
+
+    return RunResult(
+        params=params, log=log,
+        sent=np.asarray(events.sent).T, recv=np.asarray(events.recv).T,
+        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
+        fail_time=plan.fail_time,
+        wall_seconds=_time.time() - t0,
+        extra={"final_state": final_state},
+    )

@@ -1,0 +1,84 @@
+"""Gossip delivery as heartbeat-max propagation.
+
+The reference's entire LIST burst — one 19-byte message per live member-list
+entry, per target, per tick (MP1Node::sendMemberList, MP1Node.cpp:360-395) —
+is semantically *heartbeat-max propagation over a random fanout graph*: the
+receiver-side merge (updatelistCallBack, MP1Node.cpp:259-301) keeps the max
+heartbeat per entry and is commutative in the incoming message set.  So
+instead of a mailbox we compute, per tick,
+
+    contrib[r, e] = max over senders s targeting r of hb[s, e]   (live e only)
+
+and max-combine ``contrib`` into the receiver's pending-delivery buffer.
+Message *counts* (the reference's sent_msgs/recv_msgs profiling matrices,
+EmulNet.h:83-84) and per-message Bernoulli drops (ENsend, EmulNet.cpp:92)
+are preserved exactly: each (sender, receiver, entry) triple is one message.
+
+The dense [S, R, E] intermediate is materialized in sender chunks to bound
+memory; the chunk loop is a ``lax.scan`` (static trip count, TPU-friendly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_size(n: int, budget_elems: int = 1 << 22) -> int:
+    """Largest divisor of n such that chunk*n*n stays within budget."""
+    per_sender = max(n * n, 1)
+    target = max(budget_elems // per_sender, 1)
+    best = 1
+    for c in range(1, n + 1):
+        if n % c == 0 and c <= target:
+            best = c
+    return best
+
+
+def fanout_deliver(key: jax.Array, target_mask: jax.Array, send_hb: jax.Array,
+                   drop_active: jax.Array, drop_prob: float):
+    """Deliver one tick of gossip.
+
+    Args:
+      key: PRNG key for per-message drop decisions.
+      target_mask: ``[S, R]`` bool — sender s gossips to receiver r this tick.
+      send_hb: ``[S, E]`` int32 — heartbeat per live entry, -1 where the entry
+        is absent or withheld (the TFAIL staleness gate, MP1Node.cpp:376).
+      drop_active: scalar bool — whether the message-drop window is open
+        (Application.cpp:177-179,198-200).
+      drop_prob: static float — effective drop probability.  The reference
+        computes ``rand()%100 < int(p*100)`` (EmulNet.cpp:90-92), i.e. the
+        effective probability is ``int(p*100)/100``; callers pass that.
+
+    Returns:
+      contrib:  ``[R, E]`` int32 — max heartbeat arriving per (receiver, entry),
+                -1 where nothing arrived.
+      sent:     ``[S]`` int32 — messages accepted from each sender (post-drop,
+                matching the reference counting sends after the drop check).
+      recv_add: ``[R]`` int32 — messages now in flight to each receiver.
+    """
+    s, r = target_mask.shape
+    e = send_hb.shape[1]
+    c = _chunk_size(s)
+    n_chunks = s // c
+    tm = target_mask.reshape(n_chunks, c, r)
+    sh = send_hb.reshape(n_chunks, c, e)
+    keys = jax.random.split(key, n_chunks)
+    use_drops = drop_prob > 0.0
+
+    def body(carry, inp):
+        contrib, recv_add = carry
+        tm_c, sh_c, key_c = inp
+        mask = tm_c[:, :, None] & (sh_c >= 0)[:, None, :]          # [c, R, E]
+        if use_drops:
+            dropped = jax.random.bernoulli(key_c, drop_prob, (c, r, e))
+            mask = mask & ~(dropped & drop_active)
+        vals = jnp.where(mask, sh_c[:, None, :], -1)
+        contrib = jnp.maximum(contrib, vals.max(axis=0))
+        recv_add = recv_add + mask.sum(axis=(0, 2), dtype=jnp.int32)
+        sent_c = mask.sum(axis=(1, 2), dtype=jnp.int32)
+        return (contrib, recv_add), sent_c
+
+    init = (jnp.full((r, e), -1, jnp.int32), jnp.zeros((r,), jnp.int32))
+    (contrib, recv_add), sent_chunks = jax.lax.scan(body, init, (tm, sh, keys))
+    return contrib, sent_chunks.reshape(s), recv_add

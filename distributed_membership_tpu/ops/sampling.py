@@ -1,0 +1,37 @@
+"""Vectorized gossip-target sampling.
+
+The reference picks gossip targets by rejection sampling: draw uniform
+indices into the member list, skip self / suspected-failed / duplicates,
+until FANOUT distinct targets (MP1Node.cpp:449-489).  The resulting *set* is
+a uniform random k-subset of the eligible entries.  On TPU we produce the
+identically-distributed subset in one shot: attach an iid uniform score to
+every eligible slot and keep the k smallest — no data-dependent loop, fully
+vmappable, identical distribution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_k_distinct(key: jax.Array, eligible: jax.Array, k: jax.Array) -> jax.Array:
+    """Select a uniform random subset of ``k[i]`` True positions per row.
+
+    Args:
+      key: PRNG key.
+      eligible: ``[N, M]`` bool — candidate positions per row.
+      k: ``[N]`` int — subset size per row (values beyond the number of
+        eligible positions select all of them).
+
+    Returns:
+      ``[N, M]`` bool mask with ``min(k[i], eligible[i].sum())`` True
+      positions per row, uniformly distributed over eligible subsets.
+    """
+    n, m = eligible.shape
+    scores = jax.random.uniform(key, (n, m))
+    scores = jnp.where(eligible, scores, 2.0)  # ineligible sorts last
+    sorted_scores = jnp.sort(scores, axis=1)
+    kth = jnp.take_along_axis(
+        sorted_scores, jnp.clip(k - 1, 0, m - 1)[:, None], axis=1)
+    return eligible & (scores <= kth) & (k > 0)[:, None]

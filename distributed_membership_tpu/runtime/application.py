@@ -42,11 +42,18 @@ def main(argv=None) -> int:
                     help="override BACKEND from the conf (emul|emul_native|tpu|tpu_sharded|tpu_sparse)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--out-dir", default=".")
+    ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
+                    help="pin the jax platform (e.g. cpu for hermetic runs on "
+                         "a virtual device mesh)")
     ap.add_argument("--grade", metavar="SCENARIO", default=None,
                     choices=sorted(SCENARIO_GRADERS),
                     help="self-grade the run with the ported grading oracle")
     ap.add_argument("--json", action="store_true", help="print a JSON summary line")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     result = run_conf(args.conf, backend=args.backend, seed=args.seed,
                       out_dir=args.out_dir)

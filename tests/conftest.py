@@ -2,21 +2,31 @@
 
 TPU-path tests run on a virtual 8-device CPU mesh: multi-chip hardware is not
 available in CI, so sharding correctness is validated with
-``xla_force_host_platform_device_count`` (the standard JAX trick), while the
-single-chip path runs on whatever platform is present.  Must be set before
-jax is first imported.
+``xla_force_host_platform_device_count`` (the standard JAX trick).
+
+Environment note: this image boots every interpreter with an `axon` PJRT
+plugin (sitecustomize on PYTHONPATH) that forces ``jax_platforms=axon,cpu``
+and dials a TPU relay during backend init — if the relay is down, any
+``jax.devices()`` hangs.  Tests must be hermetic, so we pin the platform
+to cpu via ``jax.config`` *after* import (the env var alone is overridden
+by the plugin's registration) and set the device-count flag before first
+backend initialization.
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import pathlib
+import jax  # noqa: E402
 
-import pytest
+jax.config.update("jax_platforms", "cpu")
+
+import pathlib  # noqa: E402
+
+import pytest  # noqa: E402
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 

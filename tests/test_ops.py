@@ -1,0 +1,69 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.ops.merge import fanout_deliver, _chunk_size
+from distributed_membership_tpu.ops.sampling import sample_k_distinct
+
+
+def test_sample_k_distinct_sizes():
+    key = jax.random.PRNGKey(0)
+    eligible = jnp.asarray([[1, 1, 1, 1, 0], [1, 0, 0, 0, 0], [0] * 5, [1] * 5],
+                           dtype=bool)
+    k = jnp.asarray([2, 3, 2, 0])
+    sel = sample_k_distinct(key, eligible, k)
+    counts = np.asarray(sel.sum(1))
+    # Row 0: 2 of 4; row 1: k>eligible -> all 1; row 2: nothing; row 3: k=0.
+    assert counts.tolist() == [2, 1, 0, 0]
+    assert not np.any(np.asarray(sel) & ~np.asarray(eligible))
+
+
+def test_sample_k_distinct_uniform():
+    # Each of 6 eligible slots should be chosen ~k/6 of the time.
+    key = jax.random.PRNGKey(1)
+    eligible = jnp.ones((2000, 6), bool)
+    k = jnp.full((2000,), 2)
+    sel = np.asarray(sample_k_distinct(key, eligible, k))
+    freq = sel.mean(0)
+    assert np.allclose(freq, 2 / 6, atol=0.04), freq
+
+
+def test_fanout_deliver_max_and_counts():
+    # 3 senders, 3 receivers, 4 entries.
+    target = jnp.asarray([[0, 1, 1], [0, 0, 1], [0, 0, 0]], bool)
+    hb = jnp.asarray([[5, -1, 7, 0], [2, 9, -1, 1], [3, 3, 3, 3]], jnp.int32)
+    contrib, sent, recv = fanout_deliver(
+        jax.random.PRNGKey(0), target, hb, jnp.asarray(False), 0.0)
+    # Receiver 1 hears only sender 0; receiver 2 hears senders 0 and 1 (max).
+    np.testing.assert_array_equal(np.asarray(contrib[0]), [-1, -1, -1, -1])
+    np.testing.assert_array_equal(np.asarray(contrib[1]), [5, -1, 7, 0])
+    np.testing.assert_array_equal(np.asarray(contrib[2]), [5, 9, 7, 1])
+    # Sender 0: 3 live entries x 2 targets = 6 msgs; sender 1: 3x1.
+    np.testing.assert_array_equal(np.asarray(sent), [6, 3, 0])
+    np.testing.assert_array_equal(np.asarray(recv), [0, 3, 6])
+
+
+def test_fanout_deliver_drops():
+    target = jnp.ones((1, 1), bool)
+    hb = jnp.zeros((1, 1), jnp.int32)
+    n_kept = 0
+    for s in range(300):
+        _, sent, _ = fanout_deliver(jax.random.PRNGKey(s), target, hb,
+                                    jnp.asarray(True), 0.5)
+        n_kept += int(sent[0])
+    assert 100 < n_kept < 200  # ~150 expected at p=0.5
+
+
+def test_fanout_deliver_drop_window_closed():
+    target = jnp.ones((1, 1), bool)
+    hb = jnp.zeros((1, 1), jnp.int32)
+    for s in range(20):
+        _, sent, _ = fanout_deliver(jax.random.PRNGKey(s), target, hb,
+                                    jnp.asarray(False), 0.5)
+        assert int(sent[0]) == 1  # window closed: nothing dropped
+
+
+def test_chunk_size_divides():
+    for n in (1, 10, 12, 256, 1000, 1024):
+        c = _chunk_size(n)
+        assert n % c == 0 and 1 <= c <= n
