@@ -1,0 +1,66 @@
+"""Headline benchmark: simulated node-ticks/sec on one chip.
+
+Runs the vectorized backend's full jitted scan on a synthetic cluster
+(default: 8192 nodes, fanout 3, batch join, one crash — BASELINE.json's
+single-chip scale config, sized to dense state) and reports steady-state
+throughput.
+
+Baseline: the C++ reference simulates 10 nodes x 700 ticks in 0.22-0.46 s on
+one CPU core — ~15-32k node-ticks/s (BASELINE.md, measured; the reference
+publishes no numbers of its own).  ``vs_baseline`` is against the top of
+that range.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random as _pyrandom
+import time
+
+
+REFERENCE_NODE_TICKS_PER_SEC = 32_000.0  # BASELINE.md wall-clock row, best case
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", "8192"))
+    ticks = int(os.environ.get("BENCH_TICKS", "100"))
+    fanout = int(os.environ.get("BENCH_FANOUT", "3"))
+
+    import jax
+
+    from distributed_membership_tpu.backends.tpu import run_scan
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    params = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0.0\n"
+        f"FANOUT: {fanout}\nTOTAL_TIME: {ticks}\nFAIL_TIME: {ticks // 2}\n"
+        f"JOIN_MODE: batch\nBACKEND: tpu\n")
+    plan = make_plan(params, _pyrandom.Random("app:0"))
+
+    # Warmup: compile + first execution.
+    final_state, _ = run_scan(params, plan, seed=0, collect_events=False)
+    jax.block_until_ready(final_state)
+
+    # Timed: the jit cache is warm; this measures the scan itself.
+    t0 = time.perf_counter()
+    final_state, events = run_scan(params, plan, seed=1, collect_events=False)
+    jax.block_until_ready(final_state)
+    wall = time.perf_counter() - t0
+
+    value = n * ticks / wall
+    print(json.dumps({
+        "metric": f"node_ticks_per_sec (N={n}, fanout={fanout}, "
+                  f"{ticks} ticks, {jax.devices()[0].platform})",
+        "value": round(value, 1),
+        "unit": "node-ticks/s/chip",
+        "vs_baseline": round(value / REFERENCE_NODE_TICKS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,8 +49,8 @@ from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
-from distributed_membership_tpu.ops.merge import fanout_deliver
-from distributed_membership_tpu.ops.sampling import sample_k_distinct
+from distributed_membership_tpu.ops.merge import broadcast_deliver, fanout_deliver_indexed
+from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.runtime.failures import FailurePlan, log_failures, make_plan
 
 I32 = jnp.int32
@@ -218,19 +218,26 @@ def make_step(cfg: StepConfig):
         eligible = eligible.at[intro].set(eligible[intro] & ~seed_burst)
         n_seeds_row = jnp.where(idx == intro, jnp.where(act[intro], n_seeds, 0), 0)
         k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
-        target_mask = sample_k_distinct(k_targets, eligible, k_extra)
-        target_mask = target_mask.at[intro].set(target_mask[intro] | seed_burst)
-        target_mask = target_mask & act[:, None]
+        targets_idx, targets_valid = sample_k_indices(
+            k_targets, eligible, k_extra, min(cfg.fanout, n))
 
         # Send: one message per (sender, target, live entry); stale entries
         # withheld (MP1Node.cpp:376 — prevents failed-node resurrection).
+        # Random-fanout traffic rides the O(N*K*E) indexed scatter; the
+        # introducer's unbounded burst to this tick's joiners is a separate
+        # broadcast.
         send_hb = jnp.where(fresh, hb, -1)
-        contrib, sent_list, recv_add = fanout_deliver(
-            k_drop, target_mask, send_hb, drop_active, cfg.drop_prob)
+        k_drop_f, k_drop_s = jax.random.split(k_drop)
+        contrib, sent_list, recv_add = fanout_deliver_indexed(
+            k_drop_f, targets_idx, targets_valid, send_hb, n,
+            drop_active, cfg.drop_prob)
+        contrib_seed, sent_seed, recv_seed = broadcast_deliver(
+            k_drop_s, seed_burst, send_hb[intro], drop_active, cfg.drop_prob)
+        contrib = jnp.maximum(contrib, contrib_seed)
         infl_has = infl_has | (contrib >= 0)
         infl_hb = jnp.maximum(infl_hb, contrib)
-        pending_recv = pending_recv + recv_add
-        sent_tick = sent_list + sent_req + sent_rep
+        pending_recv = pending_recv + recv_add + recv_seed
+        sent_tick = (sent_list.at[intro].add(sent_seed) + sent_req + sent_rep)
 
         # ---- failure injection, end of tick (Application::fail) ----
         failed = state.failed | (fail_mask & (t == fail_time))

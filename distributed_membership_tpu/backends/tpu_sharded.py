@@ -1,0 +1,305 @@
+"""`tpu_sharded` backend: the node axis sharded over a device mesh.
+
+Same protocol, same tick semantics as the dense `tpu` backend
+(backends/tpu.py — see its docstring for the exactness argument), with the
+``[N, N]`` state row-sharded over a 1-D :class:`jax.sharding.Mesh`: shard
+``s`` owns nodes ``[s*L, (s+1)*L)`` — their member-list rows, in-flight
+buffers, and scalar per-node state.  The whole 700-tick ``lax.scan`` runs
+*inside* one ``shard_map`` call, so state never leaves the devices and each
+tick's cross-shard traffic is exactly two collectives:
+
+  * gossip delivery: each shard max-reduces its local senders' contributions
+    into a partial ``[N, E]`` tensor, then a **ppermute ring reduce-scatter
+    (max)** delivers each receiver-row block to its owner shard
+    (parallel/collectives.py — bandwidth-optimal on ICI, the TPU-native
+    replacement for the reference's global EmulNet mailbox);
+  * message counts: a sum reduce-scatter (``lax.psum_scatter``).
+
+Plus a handful of tiny ``[N]``-bool ``all_gather``s for the join handshake
+(the introducer needs the global JOINREQ view; everyone needs the
+introducer's liveness bit).
+
+RNG discipline: the target-sampling scores and control-drop coins are drawn
+*replicated* (same key on every shard) and row-sliced, so in drop-free runs
+this backend's trajectory is bit-identical to the dense backend's
+(tests/test_sharded.py); per-message gossip drops are decorrelated per shard
+(fold_in on the shard index) and match only distributionally.
+"""
+
+from __future__ import annotations
+
+import functools
+import random as _pyrandom
+import time as _time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.backends.tpu import (
+    I32, State, StepConfig, TickEvents, events_to_log)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.ops.merge import broadcast_deliver, fanout_deliver_indexed
+from distributed_membership_tpu.ops.sampling import sample_k_indices
+from distributed_membership_tpu.parallel.collectives import (
+    all_gather_vec, reduce_scatter_sum, ring_reduce_scatter_max)
+from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from distributed_membership_tpu.runtime.failures import make_plan
+
+INTRO = INTRODUCER_INDEX
+
+
+def make_sharded_step(cfg: StepConfig, n_local: int):
+    """Per-tick transition over shard-local state.
+
+    Shapes inside shard_map: matrices ``[L, N]`` (this shard's rows of the
+    global ``[N, N]``), per-node vectors ``[L]``.  ``row0`` is this shard's
+    first global row index.
+    """
+    n = cfg.n
+
+    def step(state: State, inputs):
+        t, key, start_ticks_g, fail_mask_l, fail_time, drop_lo, drop_hi = inputs
+        k_targets, k_drop, k_ctrl = jax.random.split(key, 3)
+        me = lax.axis_index(NODE_AXIS)
+        row0 = me * n_local
+        lrows = row0 + jnp.arange(n_local)          # global ids of local rows
+        start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,), (n_local,))
+        col_ids = jnp.arange(n)
+
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+        if cfg.drop_prob > 0.0:  # replicated draw — identical on every shard
+            ctrl_kept_g = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+                            & drop_active)
+        else:
+            ctrl_kept_g = jnp.ones((2, n), bool)
+
+        # ---- delivery & merge (local rows only) ----
+        recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        deliver = state.infl_has & recv_mask[:, None]
+        newly = deliver & ~state.present
+        upd = deliver & state.present & (state.infl_hb > state.hb)
+        present = state.present | newly
+        hb = jnp.where(newly | upd, state.infl_hb, state.hb)
+        ts = jnp.where(newly | upd, t, state.ts)
+        infl_has = state.infl_has & ~recv_mask[:, None]
+        infl_hb = jnp.where(recv_mask[:, None], -1, state.infl_hb)
+        join_events = newly
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        in_group = state.in_group | (state.joinrep_infl & recv_mask)
+        joinrep_infl = state.joinrep_infl & ~recv_mask
+
+        # ---- join handshake: needs the global view of tiny vectors ----
+        # recv eligibility of the introducer (lives on shard 0).
+        started_g = all_gather_vec(state.started, NODE_AXIS)
+        failed_g = all_gather_vec(state.failed, NODE_AXIS)
+        in_group_g = all_gather_vec(in_group, NODE_AXIS)
+        intro_recv = (started_g[INTRO] & (t > start_ticks_g[INTRO])
+                      & ~failed_g[INTRO])
+        joinreq_g = all_gather_vec(state.joinreq_infl, NODE_AXIS)
+        seeds_g = joinreq_g & intro_recv
+        joinreq_l = state.joinreq_infl & ~intro_recv
+        rep_ok_g = seeds_g & ctrl_kept_g[1]
+        joinrep_infl = joinrep_infl | lax.dynamic_slice(rep_ok_g, (row0,), (n_local,))
+        n_seeds = seeds_g.sum(dtype=I32)
+        sent_rep = jnp.where((lrows == INTRO) & intro_recv,
+                             rep_ok_g.sum(dtype=I32), 0)
+        pending_recv = pending_recv + lax.dynamic_slice(
+            rep_ok_g, (row0,), (n_local,)).astype(I32)
+
+        # ---- nodeStart ----
+        start_now_l = t == start_ticks_l
+        started = state.started | start_now_l
+        boot = (t == start_ticks_g[INTRO])
+        is_intro_row = lrows == INTRO
+        intro_diag = is_intro_row[:, None] & (col_ids == INTRO)[None, :]
+        present = jnp.where(intro_diag & boot, True, present)
+        hb = jnp.where(intro_diag & boot, 0, hb)
+        ts = jnp.where(intro_diag & boot, t, ts)
+        in_group = in_group | (is_intro_row & boot)
+
+        # JOINREQs: visible to all shards from the static schedule +
+        # replicated drop coins; shard 0 merges them into the introducer's
+        # in-flight row, every shard updates its own joiners' pending flags.
+        start_now_g = t == start_ticks_g
+        joiner_req_g = start_now_g & (col_ids != INTRO) & ctrl_kept_g[0]
+        req_row = is_intro_row[:, None] & joiner_req_g[None, :]
+        infl_has = infl_has | req_row
+        infl_hb = jnp.where(req_row, jnp.maximum(infl_hb, 0), infl_hb)
+        joinreq_infl = joinreq_l | (start_now_l & (lrows != INTRO)
+                                    & lax.dynamic_slice(ctrl_kept_g[0], (row0,), (n_local,)))
+        pending_recv = pending_recv + jnp.where(
+            is_intro_row, joiner_req_g.sum(dtype=I32), 0)
+        sent_req = (start_now_l & (lrows != INTRO)
+                    & lax.dynamic_slice(ctrl_kept_g[0], (row0,), (n_local,))).astype(I32)
+
+        # ---- nodeLoopOps on local rows ----
+        act = started & (t > start_ticks_l) & ~state.failed & in_group
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        diag = lrows[:, None] == col_ids[None, :]
+        present = jnp.where(diag & act[:, None], True, present)
+        hb = jnp.where(diag & act[:, None], own_hb[:, None], hb)
+        ts = jnp.where(diag & act[:, None], t, ts)
+
+        difft = t - ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        present = present & ~removes
+
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        seed_burst_g = seeds_g & in_group_g[INTRO] & intro_recv
+        eligible = fresh & ~diag & act[:, None]
+        eligible = jnp.where(is_intro_row[:, None], eligible & ~seed_burst_g[None, :],
+                             eligible)
+        n_seeds_row = jnp.where(is_intro_row & act, n_seeds, 0)
+        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        # Replicated [N, N] score draw sliced to local rows: selections match
+        # the dense backend bit-for-bit for the same seed.
+        scores_g = jax.random.uniform(k_targets, (n, n))
+        scores_l = lax.dynamic_slice(scores_g, (row0, 0), (n_local, n))
+        targets_idx, targets_valid = sample_k_indices(
+            k_targets, eligible, k_extra, min(cfg.fanout, n), scores=scores_l)
+
+        # ---- gossip: local partial → ring reduce-scatter(max) over ICI ----
+        send_hb = jnp.where(fresh, hb, -1)
+        k_drop_f, k_drop_s = jax.random.split(jax.random.fold_in(k_drop, me))
+        contrib_partial, sent_list, recv_add_partial = fanout_deliver_indexed(
+            k_drop_f, targets_idx, targets_valid, send_hb, n,
+            drop_active, cfg.drop_prob)
+        # Introducer burst to new joiners: contributed only by the shard that
+        # owns the introducer's row; other shards pass an empty recipient set.
+        intro_shard, intro_local_row = divmod(INTRO, n_local)
+        seed_recipients = seed_burst_g & (me == intro_shard)
+        contrib_seed, sent_seed, recv_seed = broadcast_deliver(
+            k_drop_s, seed_recipients, send_hb[intro_local_row],
+            drop_active, cfg.drop_prob)
+        contrib_partial = jnp.maximum(contrib_partial, contrib_seed)
+        sent_list = jnp.where(is_intro_row, sent_list + sent_seed, sent_list)
+        contrib_local = ring_reduce_scatter_max(contrib_partial, NODE_AXIS)
+        recv_add = reduce_scatter_sum(recv_add_partial + recv_seed, NODE_AXIS)
+        infl_has = infl_has | (contrib_local >= 0)
+        infl_hb = jnp.maximum(infl_hb, contrib_local)
+        pending_recv = pending_recv + recv_add
+        sent_tick = sent_list + sent_req + sent_rep
+
+        failed = state.failed | (fail_mask_l & (t == fail_time))
+
+        new_state = State(present, hb, ts, started, in_group, failed, self_hb,
+                          infl_has, infl_hb, joinreq_infl, joinrep_infl,
+                          pending_recv)
+        return new_state, TickEvents(join_events, removes, sent_tick, recv_tick)
+
+    return step
+
+
+def init_local_state(n: int, n_local: int) -> State:
+    return State(
+        present=jnp.zeros((n_local, n), bool),
+        hb=jnp.zeros((n_local, n), I32),
+        ts=jnp.zeros((n_local, n), I32),
+        started=jnp.zeros((n_local,), bool),
+        in_group=jnp.zeros((n_local,), bool),
+        failed=jnp.zeros((n_local,), bool),
+        self_hb=jnp.zeros((n_local,), I32),
+        infl_has=jnp.zeros((n_local, n), bool),
+        infl_hb=jnp.full((n_local, n), -1, I32),
+        joinreq_infl=jnp.zeros((n_local,), bool),
+        joinrep_infl=jnp.zeros((n_local,), bool),
+        pending_recv=jnp.zeros((n_local,), I32),
+    )
+
+
+def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
+                     total_time: Optional[int] = None):
+    """Jit + shard_map the full simulation over the mesh."""
+    n = params.EN_GPSZ
+    s = mesh.shape[NODE_AXIS]
+    if n % s != 0:
+        raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {s}")
+    n_local = n // s
+    total = total_time if total_time is not None else params.TOTAL_TIME
+    cfg = StepConfig(
+        n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
+        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0)
+    step = make_sharded_step(cfg, n_local)
+
+    start_ticks = jnp.asarray([params.start_tick(i) for i in range(n)], I32)
+    fail_mask = np.zeros((n,), bool)
+    fail_time = -1
+    if plan.fail_time is not None:
+        fail_mask[plan.failed_indices] = True
+        fail_time = plan.fail_time
+    drop_lo = plan.drop_start if plan.drop_start is not None else total + 1
+    drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
+
+    ticks = jnp.arange(total, dtype=I32)
+    keys = jax.vmap(lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
+
+    def whole_run(keys, fail_mask_l):
+        # fail_mask_l: [L] local slice; everything else replicated.
+        state0 = init_local_state(n, n_local)
+        inputs = (ticks, keys,
+                  jnp.broadcast_to(start_ticks, (total, n)),
+                  jnp.broadcast_to(fail_mask_l, (total, n_local)),
+                  jnp.full((total,), fail_time, I32),
+                  jnp.full((total,), drop_lo, I32),
+                  jnp.full((total,), drop_hi, I32))
+        final, events = lax.scan(step, state0, inputs)
+        return final, events
+
+    sharded = shard_map(
+        whole_run, mesh=mesh,
+        in_specs=(P(), P(NODE_AXIS)),
+        out_specs=(
+            State(*(P(NODE_AXIS) for _ in State._fields)),
+            TickEvents(joins=P(None, NODE_AXIS, None),
+                       removes=P(None, NODE_AXIS, None),
+                       sent=P(None, NODE_AXIS), recv=P(None, NODE_AXIS)),
+        ),
+        check_vma=False,
+    )
+
+    final_state, events = jax.jit(sharded)(keys, jnp.asarray(fail_mask))
+    return final_state, jax.tree.map(np.asarray, events)
+
+
+@register("tpu_sharded")
+def run_tpu_sharded(params: Params, log: Optional[EventLog] = None,
+                    seed: Optional[int] = None,
+                    mesh: Optional[Mesh] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    if mesh is None:
+        # Largest device count that divides N (grader N=10 on 8 devices → 5).
+        n_dev = len(jax.devices())
+        s = max(d for d in range(1, n_dev + 1) if params.EN_GPSZ % d == 0)
+        mesh = make_mesh(s)
+
+    final_state, events = run_scan_sharded(params, plan, seed, mesh)
+    events_to_log(params, plan, events, log)
+
+    return RunResult(
+        params=params, log=log,
+        sent=np.asarray(events.sent).T, recv=np.asarray(events.recv).T,
+        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
+        fail_time=plan.fail_time,
+        wall_seconds=_time.time() - t0,
+        extra={"final_state": final_state, "mesh_size": mesh.shape[NODE_AXIS]},
+    )

@@ -82,3 +82,69 @@ def fanout_deliver(key: jax.Array, target_mask: jax.Array, send_hb: jax.Array,
     init = (jnp.full((r, e), -1, jnp.int32), jnp.zeros((r,), jnp.int32))
     (contrib, recv_add), sent_chunks = jax.lax.scan(body, init, (tm, sh, keys))
     return contrib, sent_chunks.reshape(s), recv_add
+
+
+def fanout_deliver_indexed(key: jax.Array, targets: jax.Array,
+                           valid: jax.Array, send_hb: jax.Array,
+                           n_receivers: int, drop_active: jax.Array,
+                           drop_prob: float):
+    """Scatter-max gossip delivery with targets in index form.
+
+    The production path: O(S * K * E) work and memory instead of
+    :func:`fanout_deliver`'s dense O(S * R * E) mask (kept as the executable
+    spec / for tests).  Delivers exactly the same messages for the same
+    target sets.
+
+    Args:
+      targets: ``[S, K]`` int32 — receiver index per (sender, slot).
+      valid:   ``[S, K]`` bool — slot actually targeted.
+      send_hb: ``[S, E]`` int32 — live-entry heartbeats, -1 = withheld.
+      n_receivers: R.
+      drop_active / drop_prob: as in :func:`fanout_deliver`; the Bernoulli
+        drop is per (sender, slot, entry) — one coin per wire message,
+        matching ENsend (EmulNet.cpp:92).
+
+    Returns ``(contrib [R, E], sent [S], recv_add [R])``.
+    """
+    s, k = targets.shape
+    e = send_hb.shape[1]
+    live = send_hb >= 0                                     # [S, E]
+    msg = valid[:, :, None] & live[:, None, :]              # [S, K, E]
+    if drop_prob > 0.0:
+        dropped = jax.random.bernoulli(key, drop_prob, (s, k, e))
+        msg = msg & ~(dropped & drop_active)
+    vals = jnp.where(msg, send_hb[:, None, :], -1)          # [S, K, E]
+    # Invalid slots scatter to a scrap row R (out-of-range handled by 'drop').
+    tgt = jnp.where(valid, targets, n_receivers).reshape(s * k)
+    contrib = jnp.full((n_receivers + 1, e), -1, jnp.int32)
+    contrib = contrib.at[tgt].max(vals.reshape(s * k, e), mode="drop")
+    sent = msg.sum(axis=(1, 2), dtype=jnp.int32)
+    counts = msg.sum(axis=2, dtype=jnp.int32).reshape(s * k)
+    recv_add = jnp.zeros((n_receivers + 1,), jnp.int32).at[tgt].add(
+        counts, mode="drop")
+    return contrib[:n_receivers], sent, recv_add[:n_receivers]
+
+
+def broadcast_deliver(key: jax.Array, recipients: jax.Array,
+                      send_hb: jax.Array, drop_active: jax.Array,
+                      drop_prob: float):
+    """One sender's full live list to a set of recipients (the introducer's
+    guaranteed burst to this tick's new joiners, MP1Node.cpp:240-242,454 —
+    whose size is unbounded by FANOUT, so it can't ride the K-slot path).
+
+    Args:
+      recipients: ``[R]`` bool.
+      send_hb: ``[E]`` int32 — the sender's live entries, -1 withheld.
+
+    Returns ``(contrib [R, E], sent scalar, recv_add [R])``.
+    """
+    r = recipients.shape[0]
+    e = send_hb.shape[0]
+    msg = recipients[:, None] & (send_hb >= 0)[None, :]     # [R, E]
+    if drop_prob > 0.0:
+        dropped = jax.random.bernoulli(key, drop_prob, (r, e))
+        msg = msg & ~(dropped & drop_active)
+    contrib = jnp.where(msg, send_hb[None, :], -1)
+    sent = msg.sum(dtype=jnp.int32)
+    recv_add = msg.sum(axis=1, dtype=jnp.int32)
+    return contrib, sent, recv_add

@@ -15,23 +15,53 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_k_distinct(key: jax.Array, eligible: jax.Array, k: jax.Array) -> jax.Array:
+def sample_k_distinct(key: jax.Array, eligible: jax.Array, k: jax.Array,
+                      scores: jax.Array | None = None) -> jax.Array:
     """Select a uniform random subset of ``k[i]`` True positions per row.
 
     Args:
-      key: PRNG key.
+      key: PRNG key (ignored if ``scores`` is given).
       eligible: ``[N, M]`` bool — candidate positions per row.
       k: ``[N]`` int — subset size per row (values beyond the number of
         eligible positions select all of them).
+      scores: optional pre-drawn iid uniform ``[N, M]`` scores — used by the
+        sharded backend, which draws the full score tensor replicated and
+        slices its local rows so shard-local selections match the dense
+        backend's exactly.
 
     Returns:
       ``[N, M]`` bool mask with ``min(k[i], eligible[i].sum())`` True
       positions per row, uniformly distributed over eligible subsets.
     """
     n, m = eligible.shape
-    scores = jax.random.uniform(key, (n, m))
+    if scores is None:
+        scores = jax.random.uniform(key, (n, m))
     scores = jnp.where(eligible, scores, 2.0)  # ineligible sorts last
-    sorted_scores = jnp.sort(scores, axis=1)
-    kth = jnp.take_along_axis(
-        sorted_scores, jnp.clip(k - 1, 0, m - 1)[:, None], axis=1)
-    return eligible & (scores <= kth) & (k > 0)[:, None]
+    # Rank-based selection (double argsort): exactly k positions even under
+    # float ties, with the same lowest-index-first tie-break as lax.top_k —
+    # keeping this spec path set-identical to sample_k_indices.
+    order = jnp.argsort(scores, axis=1, stable=True)
+    rank = jnp.argsort(order, axis=1, stable=True)
+    return eligible & (rank < k[:, None])
+
+
+def sample_k_indices(key: jax.Array, eligible: jax.Array, k: jax.Array,
+                     k_max: int, scores: jax.Array | None = None):
+    """Index-form of :func:`sample_k_distinct` via ``lax.top_k``.
+
+    Selects the same uniform k-subset (identical scores → identical set) but
+    returns it as ``([N, k_max] indices, [N, k_max] valid mask)`` — the form
+    the O(N*K*M) scatter-based gossip delivery wants, avoiding any dense
+    [senders, receivers] mask.  ``k_max`` is the static bound on ``k``
+    (the FANOUT protocol constant).
+
+    Cost per row is O(M * k_max) (top_k) instead of O(M log M) (full sort).
+    """
+    n, m = eligible.shape
+    if scores is None:
+        scores = jax.random.uniform(key, (n, m))
+    neg = jnp.where(eligible, -scores, -2.0)  # ineligible last under top_k
+    top_vals, top_idx = jax.lax.top_k(neg, min(k_max, m))
+    arange_k = jnp.arange(top_idx.shape[1])
+    valid = (arange_k[None, :] < k[:, None]) & (top_vals > -2.0)
+    return top_idx, valid

@@ -1,0 +1,66 @@
+"""ICI collectives for cross-shard gossip.
+
+The reference's communication backend is a global in-memory mailbox
+(EmulNet, SURVEY.md §2 component #3).  Sharded over a mesh, its TPU-native
+equivalent is: every shard computes a *partial* contribution tensor for all
+receivers (max over its local senders), and the shards then reduce those
+partials with ``max`` while scattering receiver rows to their owners.
+
+XLA has no built-in reduce-scatter for ``max`` (``lax.psum_scatter`` is
+sum-only), so we implement the classic ring algorithm with
+``lax.ppermute``: chunk ``b`` starts at shard ``b+1`` and travels one hop
+per step, max-combining each host's partial, arriving fully reduced at its
+owner after ``S-1`` hops.  Bandwidth-optimal: each shard moves
+``(S-1)/S`` of one copy of the data over ICI neighbor links, versus the
+``pmax`` all-reduce which replicates the whole tensor to every shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce_max(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce max over the mesh axis (every shard gets the full result)."""
+    return lax.pmax(x, axis_name)
+
+
+def ring_reduce_scatter_max(x: jax.Array, axis_name: str) -> jax.Array:
+    """Reduce-scatter with max over a 1-D mesh axis using a ppermute ring.
+
+    Args:
+      x: per-shard partial of shape ``[S*B, ...]`` — the full (unsharded)
+        first axis; shard ``s`` owns rows ``[s*B, (s+1)*B)`` of the result.
+      axis_name: mesh axis to reduce over.
+
+    Returns:
+      ``[B, ...]``: the max over all shards' partials of this shard's rows.
+    """
+    s = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    if s == 1:
+        return x
+    b = x.shape[0] // s
+    blocks = x.reshape(s, b, *x.shape[1:])
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    # Chunk destined for shard `d` starts at shard `d+1`; from shard `me`'s
+    # perspective, it holds chunk (me - 1) at step 0 and chunk (me - 1 - i)
+    # after receiving at step i, max-combining its own partial each hop.
+    acc = jnp.take(blocks, (me - 1) % s, axis=0)
+    for i in range(1, s):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = jnp.maximum(acc, jnp.take(blocks, (me - 1 - i) % s, axis=0))
+    return acc
+
+
+def reduce_scatter_sum(x: jax.Array, axis_name: str) -> jax.Array:
+    """Sum reduce-scatter of a ``[S*B, ...]`` partial (XLA-native)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+def all_gather_vec(x: jax.Array, axis_name: str) -> jax.Array:
+    """Gather a sharded ``[B, ...]`` vector into the full ``[S*B, ...]``."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)

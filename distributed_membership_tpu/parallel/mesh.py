@@ -1,0 +1,40 @@
+"""Device mesh construction for the node-sharded backends.
+
+The reference's "distribution" is N logical peers multiplexed in one process
+(SURVEY.md §2); the rebuild's real distribution axis is the *node* axis: the
+``[N, ...]`` protocol state is sharded row-wise over a 1-D mesh, gossip
+between co-located nodes stays on-chip, and cross-shard gossip rides ICI via
+the collectives in :mod:`distributed_membership_tpu.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(set --xla_force_host_platform_device_count for CPU testing)")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard axis 0 (the node axis) over the mesh."""
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def check_divisible(n: int, mesh: Mesh) -> int:
+    s = mesh.shape[NODE_AXIS]
+    if n % s != 0:
+        raise ValueError(f"node count {n} must be divisible by mesh size {s}")
+    return n // s

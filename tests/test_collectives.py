@@ -1,0 +1,62 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from distributed_membership_tpu.parallel.collectives import (
+    all_gather_vec, allreduce_max, reduce_scatter_sum, ring_reduce_scatter_max)
+from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return make_mesh(8)
+
+
+def test_ring_reduce_scatter_max_matches_pmax(mesh8):
+    n, e = 32, 12
+    x = jax.random.randint(jax.random.PRNGKey(0), (8, n, e), -5, 100)
+
+    @jax.jit
+    def run(parts):
+        def f(part):
+            part = part[0]  # [n, e] local partial
+            rs = ring_reduce_scatter_max(part, NODE_AXIS)
+            ar = allreduce_max(part, NODE_AXIS)
+            return rs[None], ar[None]
+        return shard_map(f, mesh=mesh8,
+                         in_specs=P(NODE_AXIS, None, None),
+                         out_specs=(P(NODE_AXIS, None, None),
+                                    P(NODE_AXIS, None, None)))(parts)
+
+    rs, ar = run(x)
+    expected = np.asarray(x).max(axis=0)
+    # All-reduce gives every shard the full max.
+    for s in range(8):
+        np.testing.assert_array_equal(np.asarray(ar)[s], expected)
+    # Reduce-scatter gives each shard its own rows.
+    got = np.asarray(rs).reshape(n, e)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_reduce_scatter_sum_and_gather(mesh8):
+    x = jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16)
+
+    @jax.jit
+    def run(parts):
+        def f(part):
+            total = reduce_scatter_sum(part[0], NODE_AXIS)  # [2]
+            back = all_gather_vec(total, NODE_AXIS)         # [16]
+            return total[None], back[None]
+        return shard_map(f, mesh=mesh8, in_specs=P(NODE_AXIS, None),
+                         out_specs=(P(NODE_AXIS, None), P(NODE_AXIS, None)))(parts)
+
+    total, back = run(x)
+    expected = np.asarray(x).sum(axis=0)
+    np.testing.assert_array_equal(np.asarray(total).reshape(-1), expected)
+    for s in range(8):
+        np.testing.assert_array_equal(np.asarray(back)[s], expected)

@@ -63,6 +63,40 @@ def test_fanout_deliver_drop_window_closed():
         assert int(sent[0]) == 1  # window closed: nothing dropped
 
 
+def test_indexed_matches_dense_mask_spec():
+    # The production scatter path must deliver exactly what the dense-mask
+    # executable spec delivers, for random target sets.
+    import jax.numpy as jnp
+    from distributed_membership_tpu.ops.merge import fanout_deliver_indexed
+    key = jax.random.PRNGKey(3)
+    s, r, e, k = 12, 12, 12, 4
+    hb = jax.random.randint(key, (s, e), -1, 50)
+    targets = jax.random.randint(key, (s, k), 0, r)
+    # Build the equivalent dense mask (dedupe: a receiver targeted twice in
+    # index form gets the same contribution, max is idempotent).
+    valid = jax.random.bernoulli(key, 0.7, (s, k))
+    mask = jnp.zeros((s, r), bool)
+    mask = mask.at[jnp.arange(s)[:, None], targets].max(valid)
+    c1, _, _ = fanout_deliver(jax.random.PRNGKey(0), mask, hb,
+                              jnp.asarray(False), 0.0)
+    c2, _, _ = fanout_deliver_indexed(jax.random.PRNGKey(0), targets, valid,
+                                      hb, r, jnp.asarray(False), 0.0)
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+
+def test_broadcast_deliver():
+    import jax.numpy as jnp
+    from distributed_membership_tpu.ops.merge import broadcast_deliver
+    recipients = jnp.asarray([True, False, True])
+    hb = jnp.asarray([7, -1, 3], jnp.int32)
+    contrib, sent, recv = broadcast_deliver(
+        jax.random.PRNGKey(0), recipients, hb, jnp.asarray(False), 0.0)
+    np.testing.assert_array_equal(np.asarray(contrib),
+                                  [[7, -1, 3], [-1, -1, -1], [7, -1, 3]])
+    assert int(sent) == 4
+    np.testing.assert_array_equal(np.asarray(recv), [2, 0, 2])
+
+
 def test_chunk_size_divides():
     for n in (1, 10, 12, 256, 1000, 1024):
         c = _chunk_size(n)
