@@ -51,7 +51,8 @@ from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.ops.merge import broadcast_deliver, fanout_deliver_indexed
 from distributed_membership_tpu.ops.sampling import sample_k_indices
-from distributed_membership_tpu.runtime.failures import FailurePlan, log_failures, make_plan
+from distributed_membership_tpu.runtime.failures import (
+    FailurePlan, log_failures, make_plan, plan_tensors)
 
 I32 = jnp.int32
 
@@ -255,40 +256,45 @@ def make_step(cfg: StepConfig):
     return step
 
 
+_RUNNER_CACHE: dict = {}
+
+
+def _get_runner(cfg: StepConfig):
+    """One compiled whole-run scan per config: per-run values (seed,
+    schedules, failure plan) are jit *arguments*, so a single compilation
+    serves every seed and scenario of the same shape."""
+    if cfg not in _RUNNER_CACHE:
+        step = make_step(cfg)
+
+        def run(keys, ticks, start_ticks, fail_mask, fail_time,
+                drop_lo, drop_hi):
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask,
+                                    fail_time, drop_lo, drop_hi))
+
+            return jax.lax.scan(body, init_state(cfg.n), (ticks, keys))
+
+        _RUNNER_CACHE[cfg] = jax.jit(run)
+    return _RUNNER_CACHE[cfg]
+
+
 def run_scan(params: Params, plan: FailurePlan, seed: int,
              collect_events: bool = True, total_time: Optional[int] = None):
-    """Jit-compile and run the full simulation; returns (final_state, events)."""
+    """Run the full simulation; returns (final_state, events)."""
     n = params.EN_GPSZ
     total = total_time if total_time is not None else params.TOTAL_TIME
     cfg = StepConfig(
         n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
         drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
         collect_events=collect_events)
-    step = make_step(cfg)
 
-    start_ticks = jnp.asarray([params.start_tick(i) for i in range(n)], I32)
-    fail_mask = np.zeros((n,), bool)
-    fail_time = -1
-    if plan.fail_time is not None:
-        fail_mask[plan.failed_indices] = True
-        fail_time = plan.fail_time
-    drop_lo = plan.drop_start if plan.drop_start is not None else total + 1
-    drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
 
-    ticks = jnp.arange(total, dtype=I32)
-    keys = jax.vmap(lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
-
-    @jax.jit
-    def run(keys):
-        inputs = (ticks, keys,
-                  jnp.broadcast_to(start_ticks, (total, n)),
-                  jnp.broadcast_to(jnp.asarray(fail_mask), (total, n)),
-                  jnp.full((total,), fail_time, I32),
-                  jnp.full((total,), drop_lo, I32),
-                  jnp.full((total,), drop_hi, I32))
-        return jax.lax.scan(step, init_state(n), inputs)
-
-    final_state, events = run(keys)
+    run = _get_runner(cfg)
+    final_state, events = run(keys, ticks, start_ticks, fail_mask,
+                              fail_time, drop_lo, drop_hi)
     return final_state, jax.tree.map(np.asarray, events)
 
 

@@ -51,7 +51,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.parallel.collectives import (
     all_gather_vec, reduce_scatter_sum, ring_reduce_scatter_max)
 from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
-from distributed_membership_tpu.runtime.failures import make_plan
+from distributed_membership_tpu.runtime.failures import make_plan, plan_tensors
 
 INTRO = INTRODUCER_INDEX
 
@@ -223,6 +223,45 @@ def init_local_state(n: int, n_local: int) -> State:
     )
 
 
+_RUNNER_CACHE: dict = {}
+
+
+def _get_runner(cfg: StepConfig, n_local: int, mesh: Mesh):
+    """One compiled shard_map scan per (config, mesh): per-run values are jit
+    arguments so repeated seeds/scenarios never re-trace (same pattern as
+    backends/tpu.py's _get_runner)."""
+    cache_key = (cfg, n_local, mesh)
+    if cache_key not in _RUNNER_CACHE:
+        n = cfg.n
+        step = make_sharded_step(cfg, n_local)
+
+        def whole_run(keys, ticks, start_ticks, fail_mask_l, fail_time,
+                      drop_lo, drop_hi):
+            # fail_mask_l: [L] local slice; everything else replicated.
+            state0 = init_local_state(n, n_local)
+
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask_l,
+                                    fail_time, drop_lo, drop_hi))
+
+            return lax.scan(body, state0, (ticks, keys))
+
+        sharded = shard_map(
+            whole_run, mesh=mesh,
+            in_specs=(P(), P(), P(), P(NODE_AXIS), P(), P(), P()),
+            out_specs=(
+                State(*(P(NODE_AXIS) for _ in State._fields)),
+                TickEvents(joins=P(None, NODE_AXIS, None),
+                           removes=P(None, NODE_AXIS, None),
+                           sent=P(None, NODE_AXIS), recv=P(None, NODE_AXIS)),
+            ),
+            check_vma=False,
+        )
+        _RUNNER_CACHE[cache_key] = jax.jit(sharded)
+    return _RUNNER_CACHE[cache_key]
+
+
 def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
                      total_time: Optional[int] = None):
     """Jit + shard_map the full simulation over the mesh."""
@@ -235,45 +274,13 @@ def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
     cfg = StepConfig(
         n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
         drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0)
-    step = make_sharded_step(cfg, n_local)
 
-    start_ticks = jnp.asarray([params.start_tick(i) for i in range(n)], I32)
-    fail_mask = np.zeros((n,), bool)
-    fail_time = -1
-    if plan.fail_time is not None:
-        fail_mask[plan.failed_indices] = True
-        fail_time = plan.fail_time
-    drop_lo = plan.drop_start if plan.drop_start is not None else total + 1
-    drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
 
-    ticks = jnp.arange(total, dtype=I32)
-    keys = jax.vmap(lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
-
-    def whole_run(keys, fail_mask_l):
-        # fail_mask_l: [L] local slice; everything else replicated.
-        state0 = init_local_state(n, n_local)
-        inputs = (ticks, keys,
-                  jnp.broadcast_to(start_ticks, (total, n)),
-                  jnp.broadcast_to(fail_mask_l, (total, n_local)),
-                  jnp.full((total,), fail_time, I32),
-                  jnp.full((total,), drop_lo, I32),
-                  jnp.full((total,), drop_hi, I32))
-        final, events = lax.scan(step, state0, inputs)
-        return final, events
-
-    sharded = shard_map(
-        whole_run, mesh=mesh,
-        in_specs=(P(), P(NODE_AXIS)),
-        out_specs=(
-            State(*(P(NODE_AXIS) for _ in State._fields)),
-            TickEvents(joins=P(None, NODE_AXIS, None),
-                       removes=P(None, NODE_AXIS, None),
-                       sent=P(None, NODE_AXIS), recv=P(None, NODE_AXIS)),
-        ),
-        check_vma=False,
-    )
-
-    final_state, events = jax.jit(sharded)(keys, jnp.asarray(fail_mask))
+    run = _get_runner(cfg, n_local, mesh)
+    final_state, events = run(keys, ticks, start_ticks, fail_mask,
+                              fail_time, drop_lo, drop_hi)
     return final_state, jax.tree.map(np.asarray, events)
 
 

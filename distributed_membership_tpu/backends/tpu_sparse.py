@@ -1,0 +1,524 @@
+"""`tpu_sparse` backend: bounded member views for large N.
+
+The dense `tpu` backend's ``[N, N]`` id-indexed state is exact but O(N^2) —
+structurally the same wall the reference hits with its full-list gossip
+(SURVEY.md §5 "long-context" note: the scaling axis here is node count).
+This backend is the scale path: each node keeps a bounded view of
+``M = VIEW_SIZE`` slots ``(member id, heartbeat, timestamp)`` and gossips
+``G = GOSSIP_LEN`` entries to ``FANOUT`` targets per tick — the fixed-size
+partial list the spec explicitly permits (mp1_specifications.pdf §4), i.e.
+SWIM-style dissemination with the reference's gossip-heartbeat semantics:
+
+  * receiver merge rule: max heartbeat per id, timestamp refreshed only on
+    strict increase (MP1Node.cpp:278-288) — ops/view_merge.merge_views;
+  * TFAIL/TREMOVE sweep per slot (MP1Node.cpp:429-446);
+  * stale entries withheld from gossip (MP1Node.cpp:376 — the
+    anti-resurrection rule);
+  * join handshake through the introducer (MP1Node.cpp:126-163, 226-251),
+    with the joiner's JOINREQ riding the same mailbox as gossip;
+  * messages-in-flight = per-receiver hash-slotted mailbox with max-combine
+    (ops/view_merge.scatter_mailbox): 1-tick latency like EmulNet, lossless
+    when ``MAILBOX_SIZE >= N``, bounded-capacity drops beyond — EmulNet's
+    ENBUFFSIZE behavior recast per receiver (EmulNet.h:12, EmulNet.cpp:90).
+
+With ``VIEW_SIZE = 0`` (M = N) and ``MAILBOX_SIZE >= N`` the protocol is
+equivalent to the dense backend's (same merge, same sweep, same fanout
+distribution — RNG draws differ, so parity is distributional:
+tests/test_sparse_backend.py).  With M << N it runs at 100k-1M nodes on one
+chip: all per-tick work is O(N * (M + Q + K*G)) with static shapes — two
+batched sorts, one scatter-max, one top_k — no data-dependent shapes
+anywhere, so XLA tiles every op.
+
+``JOIN_MODE: warm`` bootstraps every node in-group with a random M-slot
+neighborhood at t=0 (the standard deployment assumption for a 1M-node
+failure-detection service, where a single introducer would be the
+bottleneck); staggered/batch introducer joins remain for parity runs.
+
+**Direct probing (``PROBES > 0``) — why heartbeat gossip alone cannot scale.**
+With bounded views, news about member x reaches a given view-holder at rate
+~``FANOUT * GOSSIP_LEN / N`` per tick — entries go stale faster than TFAIL
+once ``N > FANOUT * GOSSIP_LEN * TFAIL`` and the detector drowns in false
+positives.  (The reference never sees this: its full-list gossip refreshes
+every entry at rate FANOUT, but only because each message carries all N
+entries — the O(N^2) traffic wall.)  The SWIM answer, and ours, is direct
+probing: each node pings ``PROBES`` random view members per tick (a probe
+mailbox slot keyed by prober id + the prober's own entry piggybacked), and a
+probed node acks with its current heartbeat next tick.  Entry refresh
+interval becomes ``M/PROBES + 2`` ticks — independent of N — so TFAIL/TREMOVE
+keep their O(1) meaning at any scale.  The TFAIL stage doubles as SWIM's
+suspicion state: a suspect is withheld from gossip but stays probed, and a
+late ack (strictly higher heartbeat) rescues it before TREMOVE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _pyrandom
+import time as _time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.ops.sampling import sample_k_indices
+from distributed_membership_tpu.ops.view_merge import (
+    EMPTY, merge_views, scatter_mailbox, unpack_mailbox)
+from distributed_membership_tpu.runtime.failures import (
+    FailurePlan, log_failures, make_plan, plan_tensors)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+SEED_CAP = 8  # max JOINREQs the introducer can answer per tick; the
+#               staggered schedule produces at most ceil(1/STEP_RATE) = 4.
+
+
+class SparseState(NamedTuple):
+    slot_id: jax.Array   # [N, M] i32, EMPTY = free
+    slot_hb: jax.Array   # [N, M] i32
+    slot_ts: jax.Array   # [N, M] i32
+    started: jax.Array   # [N] bool
+    in_group: jax.Array  # [N] bool
+    failed: jax.Array    # [N] bool
+    self_hb: jax.Array   # [N] i32
+    mail: jax.Array      # [N, Q] u32 packed (hb * N + id + 1), 0 = empty
+    pmail: jax.Array     # [N, Qp] u32 probe mailbox (prober id + 1), 0 = empty
+    amail: jax.Array     # [N, Qa] u32 ack mailbox — acks get their own
+    #                      channel so their delivery never competes with
+    #                      gossip volume for hash slots
+    joinreq_infl: jax.Array  # [N] bool
+    joinrep_infl: jax.Array  # [N] bool
+    pending_recv: jax.Array  # [N] i32
+
+
+class SparseTickEvents(NamedTuple):
+    join_ids: jax.Array   # [N, M] i32 — id joined into this slot, EMPTY none
+    rm_ids: jax.Array     # [N, M] i32 — id removed from this slot, EMPTY none
+    sent: jax.Array       # [N] i32
+    recv: jax.Array       # [N] i32
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseConfig:
+    n: int
+    m: int          # view slots per node
+    q: int          # mailbox slots per node
+    g: int          # entries piggybacked per gossip message
+    tfail: int
+    tremove: int
+    fanout: int
+    drop_prob: float
+    probes: int = 0  # direct probes per tick (0 = pure gossip, parity mode)
+    qp: int = 16     # probe-mailbox slots
+    qa: int = 16     # ack-mailbox slots
+    seed_cap: int = SEED_CAP  # max JOINREQs answered with a burst per tick
+    collect_events: bool = True
+
+
+def auto_mailbox_size(n: int, m: int, g: int, fanout: int) -> int:
+    """Default Q: lossless (== N) while affordable, else sized so expected
+    distinct incoming ids per tick (~ fanout * G) hash with low collision."""
+    if n <= 1024:
+        return n
+    return max(256, 4 * fanout * g)
+
+
+def init_state(cfg: SparseConfig) -> SparseState:
+    n, m, q = cfg.n, cfg.m, cfg.q
+    return SparseState(
+        slot_id=jnp.full((n, m), EMPTY, I32),
+        slot_hb=jnp.zeros((n, m), I32),
+        slot_ts=jnp.zeros((n, m), I32),
+        started=jnp.zeros((n,), bool),
+        in_group=jnp.zeros((n,), bool),
+        failed=jnp.zeros((n,), bool),
+        self_hb=jnp.zeros((n,), I32),
+        mail=jnp.zeros((n, q), U32),
+        pmail=jnp.zeros((n, cfg.qp), U32),
+        amail=jnp.zeros((n, cfg.qa), U32),
+        joinreq_infl=jnp.zeros((n,), bool),
+        joinrep_infl=jnp.zeros((n,), bool),
+        pending_recv=jnp.zeros((n,), I32),
+    )
+
+
+def init_state_warm(cfg: SparseConfig, key: jax.Array) -> SparseState:
+    """Every node in-group at t=0 with self + M-1 random neighbors (hb 0,
+    ts 0).  Sampling is with replacement — duplicate ids within a row are
+    collapsed by the first tick's merge (merge_views dedupes local slots)."""
+    n, m = cfg.n, cfg.m
+    st = init_state(cfg)
+    idx = jnp.arange(n, dtype=I32)
+    # Neighbor j of node i: i + 1 + U[0, n-2] (mod n) — never self.
+    offs = jax.random.randint(key, (n, m - 1), 1, max(n, 2), dtype=I32)
+    nbrs = jax.lax.rem(idx[:, None] + offs, n)
+    slot_id = jnp.concatenate([idx[:, None], nbrs], axis=1)
+    return st._replace(
+        slot_id=slot_id,
+        started=jnp.ones((n,), bool),
+        in_group=jnp.ones((n,), bool),
+    )
+
+
+def make_step(cfg: SparseConfig):
+    """Per-tick transition, mirroring the dense step's pass structure
+    (backends/tpu.py) on bounded state.  Pure/jittable; schedules arrive as
+    tensors so one compilation serves every seed and failure plan."""
+    n, m, q, g = cfg.n, cfg.m, cfg.q, cfg.g
+    intro = INTRODUCER_INDEX
+    idx = jnp.arange(n, dtype=I32)
+    k_max = min(cfg.fanout, m)
+
+    def step(state: SparseState, inputs):
+        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        (k_targets, k_entries, k_drop, k_ctrl,
+         k_probe, k_drop_p) = jax.random.split(key, 6)
+
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+        if cfg.drop_prob > 0.0:
+            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+                          & drop_active)
+        else:
+            ctrl_kept = jnp.ones((2, n), bool)
+
+        # ---- pass 1: receive (recvLoop gate, Application.cpp:130) ----
+        recv_mask = state.started & (t > start_ticks) & ~state.failed
+        in_id, in_hb, in_valid = unpack_mailbox(state.mail, n)
+        in_valid = in_valid & recv_mask[:, None]
+        mail = jnp.where(recv_mask[:, None], 0, state.mail)
+        # Probe mailbox: who pinged me last tick → ack them this tick.
+        ack_tgt, _, ack_valid = unpack_mailbox(state.pmail, n)
+        ack_valid = ack_valid & recv_mask[:, None]
+        pmail = jnp.where(recv_mask[:, None], 0, state.pmail)
+        # Ack mailbox: merged into the view alongside gossip deliveries.
+        a_id, a_hb, a_valid = unpack_mailbox(state.amail, n)
+        a_valid = a_valid & recv_mask[:, None]
+        amail = jnp.where(recv_mask[:, None], 0, state.amail)
+        in_id = jnp.concatenate([in_id, a_id], axis=1)
+        in_hb = jnp.concatenate([in_hb, a_hb], axis=1)
+        in_valid = jnp.concatenate([in_valid, a_valid], axis=1)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        in_group = state.in_group | (state.joinrep_infl & recv_mask)
+        joinrep_infl = state.joinrep_infl & ~recv_mask
+
+        # JOINREQs reaching the introducer: guaranteed gossip targets this
+        # tick + a JOINREP each (MP1Node.cpp:240-250).
+        seeds = state.joinreq_infl & recv_mask[intro]
+        joinreq_infl = state.joinreq_infl & ~recv_mask[intro]
+        rep_ok = seeds & ctrl_kept[1]
+        joinrep_infl = joinrep_infl | rep_ok
+        n_seeds = seeds.sum(dtype=I32)
+        sent_rep = jnp.where(idx == intro,
+                             jnp.where(recv_mask[intro], rep_ok.sum(dtype=I32), 0), 0)
+        pending_recv = pending_recv + rep_ok.astype(I32)
+
+        # ---- nodeStart (Application.cpp:143-148) ----
+        start_now = t == start_ticks
+        started = state.started | start_now
+        boot = start_now[intro]
+        in_group = in_group.at[intro].set(in_group[intro] | boot)
+        boot_row = (idx == intro) & boot
+
+        joiner_req = start_now & (idx != intro) & ctrl_kept[0]
+        joinreq_infl = joinreq_infl | joiner_req
+        mail = scatter_mailbox(
+            mail, jnp.full((n,), intro, I32), idx, jnp.zeros((n,), I32),
+            joiner_req, n, salt=t)
+        pending_recv = pending_recv.at[intro].add(joiner_req.sum(dtype=I32))
+        sent_req = joiner_req.astype(I32)
+
+        # ---- merge: mailbox + self refresh into the bounded view ----
+        act = started & (t > start_ticks) & ~state.failed & in_group
+        own_hb = state.self_hb + 1  # odd intermediate (MP1Node.cpp:412-415)
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_on = act | boot_row
+        self_ent_hb = jnp.where(boot_row, 0, own_hb)
+
+        merged = merge_views(
+            state.slot_id, state.slot_hb, state.slot_ts,
+            in_id, in_hb, in_valid,
+            idx, self_ent_hb, self_on, t,
+            apply_row=recv_mask | boot_row)
+        slot_id, slot_hb, slot_ts = merged.slot_id, merged.slot_hb, merged.slot_ts
+        join_ids = jnp.where(merged.join_mask, slot_id, EMPTY)
+
+        # ---- TFAIL / TREMOVE sweep (MP1Node.cpp:429-446) ----
+        present = slot_id != EMPTY
+        difft = t - slot_ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        rm_ids = jnp.where(removes, slot_id, EMPTY)
+        slot_id = jnp.where(removes, EMPTY, slot_id)
+        present = present & ~removes
+
+        # ---- gossip (MP1Node.cpp:449-495) ----
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed  # post-removal size, pre-removal
+        #                                      stale count (MP1Node.cpp:463)
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = slot_id == idx[:, None]
+        eligible = fresh & ~is_self_slot & act[:, None]
+        # The introducer's random targets exclude this tick's seeded joiners.
+        in_seed = seeds[jnp.clip(slot_id, 0)] & present
+        eligible = eligible.at[intro].set(eligible[intro] & ~in_seed[intro])
+        seed_burst_on = act[intro]
+        n_seeds_row = jnp.where((idx == intro) & seed_burst_on, n_seeds, 0)
+        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        tgt_slot, tgt_valid = sample_k_indices(k_targets, eligible, k_extra, k_max)
+        tgt = jnp.take_along_axis(slot_id, tgt_slot, axis=1)          # [N, K]
+
+        # Entry selection: all fresh entries when G >= M (the reference's
+        # full-list send), else self + a uniform (G-1)-subset of the rest.
+        if g >= m:
+            e_idx = jnp.broadcast_to(jnp.arange(m, dtype=I32), (n, m))
+            e_valid = fresh
+        else:
+            scores = jnp.where(is_self_slot, -1.0,
+                               jax.random.uniform(k_entries, (n, m)))
+            scores = jnp.where(fresh, scores, 2.0)
+            _, e_idx = jax.lax.top_k(-scores, g)
+            e_valid = jnp.take_along_axis(fresh, e_idx, axis=1)
+        e_ids = jnp.take_along_axis(slot_id, e_idx, axis=1)           # [N, G']
+        e_hbs = jnp.take_along_axis(slot_hb, e_idx, axis=1)
+        g_eff = e_ids.shape[1]
+
+        msg_valid = tgt_valid[:, :, None] & e_valid[:, None, :]       # [N,K,G']
+        if cfg.drop_prob > 0.0:
+            k_drop_f, k_drop_s = jax.random.split(k_drop)
+            dropped = jax.random.bernoulli(k_drop_f, cfg.drop_prob,
+                                           (n, k_max, g_eff))
+            msg_valid = msg_valid & ~(dropped & drop_active)
+        else:
+            k_drop_s = k_drop
+        tgt_b = jnp.broadcast_to(tgt[:, :, None], (n, k_max, g_eff))
+        mail = scatter_mailbox(
+            mail, tgt_b, jnp.broadcast_to(e_ids[:, None, :], (n, k_max, g_eff)),
+            jnp.broadcast_to(e_hbs[:, None, :], (n, k_max, g_eff)),
+            msg_valid, n, salt=t)
+        sent_tick = msg_valid.sum((1, 2), dtype=I32) + sent_req + sent_rep
+        recv_add = jnp.zeros((n + 1,), I32).at[
+            jnp.where(tgt_valid, tgt, n).reshape(-1)
+        ].add(msg_valid.sum(2, dtype=I32).reshape(-1), mode="drop")[:n]
+
+        # Introducer burst to this tick's joiners: its full fresh view
+        # (sendMemberList to each newNode, MP1Node.cpp:240-242,454).
+        _, seed_idx = jax.lax.top_k(seeds.astype(I32), min(cfg.seed_cap, n))
+        seed_valid = seeds[seed_idx] & seed_burst_on
+        burst_valid = seed_valid[:, None] & fresh[intro][None, :]     # [S, M]
+        if cfg.drop_prob > 0.0:
+            dropped = jax.random.bernoulli(k_drop_s, cfg.drop_prob,
+                                           (seed_idx.shape[0], m))
+            burst_valid = burst_valid & ~(dropped & drop_active)
+        mail = scatter_mailbox(
+            mail, jnp.broadcast_to(seed_idx[:, None], burst_valid.shape),
+            jnp.broadcast_to(slot_id[intro][None, :], burst_valid.shape),
+            jnp.broadcast_to(slot_hb[intro][None, :], burst_valid.shape),
+            burst_valid, n, salt=t)
+        sent_tick = sent_tick.at[intro].add(burst_valid.sum(dtype=I32))
+        recv_add = recv_add.at[seed_idx].add(
+            burst_valid.sum(1, dtype=I32) * seed_valid.astype(I32))
+
+        # ---- SWIM direct probing (see module docstring) ----
+        # Round-robin slot sweep (SWIM's randomized round-robin member
+        # selection): tick t probes the P slots starting at (t*P) mod M, so
+        # every slot is pinged at least every ceil(M/P) ticks — a
+        # *deterministic* staleness bound, unlike uniform sampling whose
+        # geometric gap tail would trickle false removals forever.
+        if cfg.probes > 0:
+            ptr = jax.lax.rem(t * cfg.probes, m)
+            off = jax.lax.rem(jnp.arange(m, dtype=I32) - ptr + 2 * m, m)
+            sweep = off < cfg.probes                                  # [M]
+            p_valid = sweep[None, :] & present & ~is_self_slot & act[:, None]
+            p_tgt = jnp.where(p_valid, slot_id, EMPTY)                # [N, M]
+            ack_ok = ack_valid & act[:, None]                         # [N, Qp]
+            if cfg.drop_prob > 0.0:
+                kd1, kd2 = jax.random.split(k_drop_p)
+                p_valid = p_valid & ~(jax.random.bernoulli(
+                    kd1, cfg.drop_prob, p_valid.shape) & drop_active)
+                ack_ok = ack_ok & ~(jax.random.bernoulli(
+                    kd2, cfg.drop_prob, ack_ok.shape) & drop_active)
+            own_id_p = jnp.broadcast_to(idx[:, None], p_tgt.shape)
+            own_hb_p = jnp.broadcast_to(own_hb[:, None], p_tgt.shape)
+            # Probe: prober id into the target's probe mailbox, prober's own
+            # entry piggybacked into the gossip mailbox (one wire message).
+            pmail = scatter_mailbox(pmail, p_tgt, own_id_p,
+                                    jnp.zeros_like(p_tgt), p_valid, n, salt=t)
+            mail = scatter_mailbox(mail, p_tgt, own_id_p, own_hb_p,
+                                   p_valid, n, salt=t)
+            # Ack: my current (id, heartbeat) back to each prober.
+            amail = scatter_mailbox(
+                amail, ack_tgt, jnp.broadcast_to(idx[:, None], ack_tgt.shape),
+                jnp.broadcast_to(own_hb[:, None], ack_tgt.shape),
+                ack_ok, n, salt=t)
+            sent_tick = sent_tick + p_valid.sum(1, dtype=I32) + ack_ok.sum(1, dtype=I32)
+            recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
+                jnp.where(p_valid, p_tgt, n).reshape(-1)
+            ].add(1, mode="drop")[:n]
+            recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
+                jnp.where(ack_ok, ack_tgt, n).reshape(-1)
+            ].add(1, mode="drop")[:n]
+
+        pending_recv = pending_recv + recv_add
+
+        # ---- failure injection, end of tick (Application::fail) ----
+        failed = state.failed | (fail_mask & (t == fail_time))
+
+        new_state = SparseState(slot_id, slot_hb, slot_ts, started, in_group,
+                                failed, self_hb, mail, pmail, amail,
+                                joinreq_infl, joinrep_infl, pending_recv)
+        if cfg.collect_events:
+            out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
+        else:
+            out = SparseTickEvents((join_ids != EMPTY).sum(dtype=I32),
+                                   (rm_ids != EMPTY).sum(dtype=I32),
+                                   sent_tick, recv_tick)
+        return new_state, out
+
+    return step
+
+
+def make_config(params: Params, collect_events: bool = True) -> SparseConfig:
+    n = params.EN_GPSZ
+    m = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
+    g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else m
+    q = (params.MAILBOX_SIZE if params.MAILBOX_SIZE > 0
+         else auto_mailbox_size(n, m, g, params.FANOUT))
+    params.validate_sparse_packing()
+    # Probe in-degree is ~PROBES in expectation (each of the ~M holders of my
+    # entry pings each view slot at rate PROBES/M); ack in-degree is exactly
+    # the probes I sent.  Lossless (== N) while affordable, else 8x headroom
+    # so per-attempt collision loss stays in the low percents and the
+    # round-robin sweep's staleness bound holds with high probability.
+    qp = qa = n if n <= 1024 else max(16, 8 * params.PROBES)
+    # Batch join delivers every JOINREQ to the introducer in one tick, so
+    # the guaranteed burst must cover all N-1 joiners; the staggered
+    # schedule produces at most ceil(1/STEP_RATE) per tick.
+    seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
+    return SparseConfig(
+        n=n, m=m, q=q, g=min(g, m), tfail=params.TFAIL,
+        tremove=params.TREMOVE, fanout=params.FANOUT,
+        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        probes=params.PROBES, qp=qp, qa=qa, seed_cap=seed_cap,
+        collect_events=collect_events)
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def _get_runner(cfg: SparseConfig, warm: bool):
+    """One compiled whole-run scan per (config, bootstrap mode).
+
+    All per-run values — seeds, schedules, failure plans — are *arguments*
+    of the jitted function, never closed-over constants, so a single
+    compilation serves every seed and scenario of the same shape.  (A fresh
+    ``@jax.jit`` closure per call would re-trace and re-compile the full
+    scan every run — tens of seconds at scale.)
+    """
+    cache_key = (cfg, warm)
+    if cache_key not in _RUNNER_CACHE:
+        step = make_step(cfg)
+
+        def run(keys, ticks, start_ticks, fail_mask, fail_time,
+                drop_lo, drop_hi, warm_key):
+            state0 = (init_state_warm(cfg, warm_key) if warm
+                      else init_state(cfg))
+
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask,
+                                    fail_time, drop_lo, drop_hi))
+
+            return jax.lax.scan(body, state0, (ticks, keys))
+
+        _RUNNER_CACHE[cache_key] = jax.jit(run)
+    return _RUNNER_CACHE[cache_key]
+
+
+def run_scan(params: Params, plan: FailurePlan, seed: int,
+             collect_events: bool = True, total_time: Optional[int] = None):
+    """Run the full simulation; returns (final_state, events)."""
+    cfg = make_config(params, collect_events)
+    n = cfg.n
+    total = total_time if total_time is not None else params.TOTAL_TIME
+    warm = params.JOIN_MODE == "warm"
+
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
+
+    run = _get_runner(cfg, warm)
+    final_state, events = run(
+        keys, ticks, start_ticks, fail_mask, fail_time, drop_lo, drop_hi,
+        jax.random.PRNGKey(seed ^ 0x5EED))
+    return final_state, jax.tree.map(np.asarray, events)
+
+
+def events_to_log(params: Params, plan: FailurePlan, events: SparseTickEvents,
+                  log: EventLog) -> None:
+    """Reconstruct dbg.log from stacked sparse event tensors (same line
+    inventory as the dense backend's events_to_log, backends/tpu.py)."""
+    n = params.EN_GPSZ
+    total = events.join_ids.shape[0]
+    starts = [params.start_tick(i) for i in range(n)]
+    for i in range(n):
+        log.log(i + 1, 0, "APP")
+
+    joins_t, joins_i, joins_s = np.nonzero(events.join_ids != EMPTY)
+    removes_t, removes_i, removes_s = np.nonzero(events.rm_ids != EMPTY)
+    join_by_tick: dict = {}
+    for t, i, s in zip(joins_t, joins_i, joins_s):
+        join_by_tick.setdefault(int(t), []).append(
+            (int(i), int(events.join_ids[t, i, s])))
+    remove_by_tick: dict = {}
+    for t, i, s in zip(removes_t, removes_i, removes_s):
+        remove_by_tick.setdefault(int(t), []).append(
+            (int(i), int(events.rm_ids[t, i, s])))
+
+    intro_failed = (plan.fail_time is not None
+                    and INTRODUCER_INDEX in plan.failed_indices)
+    warm = params.JOIN_MODE == "warm"
+    for t in range(total):
+        if not warm:
+            for i in range(n - 1, -1, -1):
+                if starts[i] == t:
+                    if i == INTRODUCER_INDEX:
+                        log.log(i + 1, t, "Starting up group...")
+                    else:
+                        log.log(i + 1, t, "Trying to join...")
+        for i, j in join_by_tick.get(t, ()):
+            log.node_add(i + 1, j + 1, t)
+        for i, j in remove_by_tick.get(t, ()):
+            log.node_remove(i + 1, j + 1, t)
+        if (not warm and t % 500 == 0 and t > starts[INTRODUCER_INDEX]
+                and not (intro_failed and t > plan.fail_time)):
+            log.log(INTRODUCER_INDEX + 1, t, f"@@time={t}")
+        if plan.fail_time == t:
+            log_failures(plan, log, t)
+
+
+@register("tpu_sparse")
+def run_tpu_sparse(params: Params, log: Optional[EventLog] = None,
+                   seed: Optional[int] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    final_state, events = run_scan(params, plan, seed)
+    events_to_log(params, plan, events, log)
+
+    return RunResult(
+        params=params, log=log,
+        sent=np.asarray(events.sent).T, recv=np.asarray(events.recv).T,
+        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
+        fail_time=plan.fail_time,
+        wall_seconds=_time.time() - t0,
+        extra={"final_state": final_state},
+    )

@@ -79,6 +79,13 @@ class Params:
     VIEW_SIZE: int = 0
     # Entries piggybacked per gossip message in the sparse backend.
     GOSSIP_LEN: int = 0  # 0 = whole view
+    # Per-receiver mailbox slots in the sparse backend (0 = auto: lossless
+    # == N while affordable, else sized to the expected per-tick in-traffic).
+    MAILBOX_SIZE: int = 0
+    # SWIM direct probes per tick in the sparse backend (0 = pure gossip).
+    # Required for bounded views at scale: refresh by gossip alone decays as
+    # FANOUT*GOSSIP_LEN/N (backends/tpu_sparse.py docstring).
+    PROBES: int = 0
     # Correlated failure injection for scale scenarios: fail RACK_FAILURES
     # whole racks of RACK_SIZE contiguous nodes at FAIL_TIME.
     RACK_SIZE: int = 0
@@ -141,14 +148,32 @@ class Params:
             )
         if self.EN_GPSZ < 1:
             raise ValueError("MAX_NNB must be >= 1")
-        if self.JOIN_MODE not in ("staggered", "batch"):
-            raise ValueError(f"JOIN_MODE must be staggered|batch, got {self.JOIN_MODE!r}")
+        if self.JOIN_MODE not in ("staggered", "batch", "warm"):
+            raise ValueError(
+                f"JOIN_MODE must be staggered|batch|warm, got {self.JOIN_MODE!r}")
+        if self.JOIN_MODE == "warm" and self.BACKEND not in ("tpu_sparse",):
+            # Warm bootstrap needs backend support (pre-seeded views); on the
+            # introducer-join backends a -1 start tick would silently
+            # simulate nothing.
+            raise ValueError(
+                f"JOIN_MODE warm is not supported by BACKEND {self.BACKEND!r}")
         # Heartbeats advance by +2 per tick (reference double increment,
         # MP1Node.cpp:412-414). int32 state is safe iff 2*TOTAL_TIME fits;
         # the TPU backends use int32 — make the bound explicit rather than
         # silently overflowing (SURVEY.md hard-part #5).
         if 2 * self.TOTAL_TIME >= 2**31:
             raise ValueError("TOTAL_TIME too large for int32 heartbeats")
+
+    def validate_sparse_packing(self) -> None:
+        """The sparse backend's mailbox packs (heartbeat, id) into uint32 as
+        ``hb * N + id + 1`` (ops/view_merge.scatter_mailbox); heartbeats reach
+        2*TOTAL_TIME + 2.  Reject configs where that overflows."""
+        max_packed = (2 * self.TOTAL_TIME + 2) * self.EN_GPSZ + self.EN_GPSZ
+        if max_packed >= 2**32:
+            raise ValueError(
+                f"MAX_NNB={self.EN_GPSZ} x TOTAL_TIME={self.TOTAL_TIME} "
+                "overflows the sparse backend's uint32 (heartbeat, id) "
+                "packing; reduce TOTAL_TIME or node count")
 
     # ------------------------------------------------------------------
     def start_tick(self, i: int) -> int:
@@ -157,6 +182,8 @@ class Params:
         Reference: node i starts when ``getcurrtime() == (int)(STEP_RATE*i)``
         (Application.cpp:143); with STEP_RATE=.25 that is i//4.
         """
+        if self.JOIN_MODE == "warm":
+            return -1  # active (and past the recv/act gates) from t=0
         if self.JOIN_MODE == "batch":
             return 0
         return int(self.STEP_RATE * i)

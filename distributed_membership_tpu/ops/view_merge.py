@@ -1,0 +1,183 @@
+"""Bounded member-view merge: the sparse analog of the dense [N, N] max-merge.
+
+At large N a dense id-indexed member table is impossible (SURVEY.md §7 hard
+part #2), so each node keeps a *bounded view*: M slots of
+``(member id, heartbeat, timestamp)``, the fixed-size partial list the spec
+explicitly permits (mp1_specifications.pdf §4: "a partial list of fixed size
+can be maintained").  The receiver-side combine stays the reference's merge
+rule — per member id keep the max heartbeat, refresh the local timestamp only
+on *strict* increase (MP1Node.cpp:278-288) — but is computed by sorting the
+concatenation of (local slots, incoming entries, a synthetic self entry) by
+``(id, -heartbeat, origin-rank)`` and keeping each id-group's head.  Two
+batched ``lax.sort``s over rows of length M+Q+1: static shapes, no
+data-dependent control flow, fully TPU-tileable.
+
+Slot-retention policy when more unique ids survive than slots (a *new*
+design decision — the reference never evicts):
+  1. the node's own entry (a node never forgets itself);
+  2. existing members (ids already in the local view), freshest heartbeat
+     first — so an entry being tracked toward TREMOVE is never dropped in
+     favor of a newcomer and failure detection over the monitored set stays
+     complete;
+  3. new members, highest heartbeat first.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+EMPTY = -1          # slot_id value for a free slot
+_ID_INF = 2**30     # sorts empty/invalid entries last
+
+
+class MergeResult(NamedTuple):
+    slot_id: jax.Array   # [N, M] i32, EMPTY where free
+    slot_hb: jax.Array   # [N, M] i32
+    slot_ts: jax.Array   # [N, M] i32
+    join_mask: jax.Array  # [N, M] bool — this slot was newly inserted (a
+    #                       grader 'joined' event: id was not in the view)
+
+
+def _has_id(sorted_ids: jax.Array, query: jax.Array) -> jax.Array:
+    """Row-batched membership test: is query[n, q] in sorted_ids[n, :]?"""
+    pos = jax.vmap(jnp.searchsorted)(sorted_ids, query)
+    pos = jnp.clip(pos, 0, sorted_ids.shape[1] - 1)
+    return jnp.take_along_axis(sorted_ids, pos, axis=1) == query
+
+
+def merge_views(
+    slot_id: jax.Array, slot_hb: jax.Array, slot_ts: jax.Array,
+    in_id: jax.Array, in_hb: jax.Array, in_valid: jax.Array,
+    self_id: jax.Array, self_hb: jax.Array, self_on: jax.Array,
+    t: jax.Array, apply_row: jax.Array,
+) -> MergeResult:
+    """Merge incoming entries (and the self refresh) into bounded views.
+
+    Args:
+      slot_id/hb/ts: ``[N, M]`` current views (id EMPTY = free slot).
+      in_id/in_hb:   ``[N, Q]`` incoming entries (drained mailbox).
+      in_valid:      ``[N, Q]`` bool — entry present.
+      self_id:       ``[N]`` each row's own member id.
+      self_hb:       ``[N]`` the self-refresh heartbeat (the odd intermediate
+                     value of the reference's double increment,
+                     MP1Node.cpp:412-415).
+      self_on:       ``[N]`` bool — row performs its self refresh this tick
+                     (the reference's nodeLoopOps eligibility).
+      t:             scalar i32 current tick (timestamp for refreshed entries).
+      apply_row:     ``[N]`` bool — rows not applying keep their view
+                     verbatim (non-receiving nodes, Application.cpp:130).
+
+    Merge semantics per id (matches backends/tpu.py's dense step):
+      * incoming hb > local hb  → hb := incoming, ts := t;
+      * incoming hb <= local hb → entry unchanged (no ts refresh);
+      * id not in view          → inserted with ts = t, join event;
+      * self entry              → hb := self_hb, ts := t (always wins: the
+        self-refresh hb strictly exceeds any gossiped echo of it).
+    """
+    n, m = slot_id.shape
+    q = in_id.shape[1]
+    L = m + q + 1
+
+    local_valid = slot_id != EMPTY
+    sorted_local = jnp.sort(jnp.where(local_valid, slot_id, _ID_INF), axis=1)
+
+    # Origin ranks (tiebreak for equal heartbeat): self=0, local=1, incoming=2
+    # — local before incoming implements the strict-increase rule.
+    self_ent_id = self_id[:, None]
+    self_ent_valid = self_on[:, None]
+    ids = jnp.concatenate([slot_id, in_id, self_ent_id], axis=1)
+    hbs = jnp.concatenate([slot_hb, in_hb, self_hb[:, None]], axis=1)
+    tss = jnp.concatenate(
+        [slot_ts, jnp.full((n, q), t, I32), jnp.full((n, 1), t, I32)], axis=1)
+    valid = jnp.concatenate([local_valid, in_valid, self_ent_valid], axis=1)
+    rank = jnp.concatenate(
+        [jnp.ones((n, m), I32), jnp.full((n, q), 2, I32), jnp.zeros((n, 1), I32)],
+        axis=1)
+
+    # Is each non-local entry's id already a member? (decides update vs join)
+    known = jnp.concatenate(
+        [jnp.ones((n, m), bool),
+         _has_id(sorted_local, jnp.concatenate([in_id, self_ent_id], axis=1))],
+        axis=1)
+
+    id_key = jnp.where(valid, ids, _ID_INF)
+    neg_hb = jnp.where(valid, -hbs, _ID_INF)
+    id_key, neg_hb, rank, tss, ids, hbs, known = jax.lax.sort(
+        (id_key, neg_hb, rank, tss, ids, hbs, known.astype(I32)), num_keys=3)
+
+    winner = (id_key != _ID_INF) & (
+        jnp.concatenate([jnp.ones((n, 1), bool),
+                         id_key[:, 1:] != id_key[:, :-1]], axis=1))
+
+    # Retention priority (see module docstring): 0 self, 1 existing member,
+    # 2 new member, 3 dropped.
+    is_self = ids == self_id[:, None]
+    keep = jnp.where(
+        ~winner, 3,
+        jnp.where(is_self, 0, jnp.where(known == 1, 1, 2))).astype(I32)
+    join = winner & (known == 0)
+
+    keep, neg_hb2, ids, hbs, tss, join = jax.lax.sort(
+        (keep, jnp.where(winner, -hbs, _ID_INF), ids, hbs, tss,
+         join.astype(I32)), num_keys=2)
+    kept = keep[:, :m] < 3
+
+    ar = apply_row[:, None]
+    new_id = jnp.where(ar, jnp.where(kept, ids[:, :m], EMPTY), slot_id)
+    new_hb = jnp.where(ar & kept, hbs[:, :m], jnp.where(ar, 0, slot_hb))
+    new_ts = jnp.where(ar & kept, tss[:, :m], jnp.where(ar, 0, slot_ts))
+    join_mask = ar & kept & (join[:, :m] == 1)
+    return MergeResult(new_id, new_hb, new_ts, join_mask)
+
+
+def scatter_mailbox(mail: jax.Array, tgt: jax.Array, msg_id: jax.Array,
+                    msg_hb: jax.Array, msg_valid: jax.Array,
+                    n_pad: int, salt: jax.Array | int = 0) -> jax.Array:
+    """Max-combine messages into per-receiver hash-slotted mailboxes.
+
+    The mailbox is the sparse analog of EmulNet's bounded global buffer
+    (EmulNet.h:35-72): ``mail`` is ``[N, Q]`` uint32 with 0 = empty and
+    ``hb * n_pad + id + 1`` otherwise.  A message lands in slot
+    ``id % Q`` of its receiver — the same id from any number of senders
+    max-combines losslessly (gossip *is* a max), and when Q >= N the slot map
+    is injective so nothing is ever lost.  Two *different* ids colliding in a
+    slot keep the higher heartbeat and drop the other — the bounded-capacity
+    drop, the reference's ENBUFFSIZE-full drop recast per receiver
+    (EmulNet.cpp:90: messages beyond capacity are silently discarded).
+
+    Args:
+      mail: ``[N, Q]`` uint32 current mailboxes.
+      tgt: ``[...]`` i32 receiver node index per message.
+      msg_id / msg_hb: ``[...]`` i32 entry payload.
+      msg_valid: ``[...]`` bool.
+      n_pad: id range bound used for packing (the global N).
+      salt: slot-map rotation (pass the tick): decorrelates *which* id pairs
+        collide across ticks, so bounded-capacity loss is i.i.d. per tick
+        instead of systematically starving the same id pair.  Injectivity
+        for Q >= N is preserved.
+
+    Requires ``max_hb * n_pad + n_pad < 2**32`` — validated by the caller
+    (config.validate_sparse_packing).
+    """
+    n, qsz = mail.shape
+    packed = (msg_hb.astype(jnp.uint32) * jnp.uint32(n_pad)
+              + msg_id.astype(jnp.uint32) + jnp.uint32(1))
+    addr = tgt * qsz + jax.lax.rem(msg_id + salt, qsz)
+    addr = jnp.where(msg_valid, addr, n * qsz).reshape(-1)
+    packed = jnp.where(msg_valid, packed, 0).reshape(-1)
+    flat = mail.reshape(-1)
+    flat = flat.at[addr].max(packed, mode="drop")
+    return flat.reshape(n, qsz)
+
+
+def unpack_mailbox(mail: jax.Array, n_pad: int):
+    """Inverse of :func:`scatter_mailbox` packing → (id, hb, valid)."""
+    valid = mail > 0
+    v = mail - jnp.uint32(1)
+    msg_id = (v % jnp.uint32(n_pad)).astype(I32)
+    msg_hb = (v // jnp.uint32(n_pad)).astype(I32)
+    return jnp.where(valid, msg_id, EMPTY), jnp.where(valid, msg_hb, -1), valid

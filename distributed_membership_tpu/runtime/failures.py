@@ -64,6 +64,38 @@ def make_plan(params: Params, rng: random.Random) -> FailurePlan:
                        drop_start, drop_stop)
 
 
+def plan_tensors(params: Params, plan: FailurePlan, seed: int, total: int):
+    """Convert a (params, plan, seed) triple into the tensor schedule every
+    jitted backend consumes: ``(ticks, keys, start_ticks, fail_mask,
+    fail_time, drop_lo, drop_hi)``.
+
+    Shared by the tpu / tpu_sharded / tpu_sparse run paths so the
+    drop-window sentinel (total + 1 = never) and the per-tick key derivation
+    (fold_in of the run seed) cannot diverge between backends.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = params.EN_GPSZ
+    start_ticks = jnp.asarray(
+        [params.start_tick(i) for i in range(n)], jnp.int32)
+    fail_mask = np.zeros((n,), bool)
+    fail_time = -1
+    if plan.fail_time is not None:
+        fail_mask[plan.failed_indices] = True
+        fail_time = plan.fail_time
+    drop_lo = plan.drop_start if plan.drop_start is not None else total + 1
+    drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
+
+    ticks = jnp.arange(total, dtype=jnp.int32)
+    keys = jax.vmap(
+        lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
+    return (ticks, keys, start_ticks, jnp.asarray(fail_mask),
+            jnp.asarray(fail_time, jnp.int32), jnp.asarray(drop_lo, jnp.int32),
+            jnp.asarray(drop_hi, jnp.int32))
+
+
 def log_failures(plan: FailurePlan, log, t: int) -> None:
     """Emit the 'Node failed at time...' lines exactly as Application.cpp:184,192."""
     from distributed_membership_tpu.addressing import index_to_id

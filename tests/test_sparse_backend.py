@@ -1,0 +1,131 @@
+"""Bounded-view (`tpu_sparse`) backend: parity + scale-regime correctness.
+
+Three layers:
+  1. the three grading scenarios pass with full-size views (M = N, lossless
+     mailbox) — the parity regime;
+  2. removal-latency distribution stays inside the reference's window
+     (BASELINE.md: 21-22 ticks for TREMOVE=20);
+  3. the scale regime — bounded views, warm bootstrap, SWIM round-robin
+     probing — detects an injected failure from every view that holds it,
+     with zero false removals in steady state (the property pure bounded
+     gossip cannot deliver; backends/tpu_sparse.py module docstring).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.backends.tpu_sparse import run_scan
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import grade_scenario
+from distributed_membership_tpu.observability.metrics import removal_latencies
+from distributed_membership_tpu.runtime.failures import make_plan
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_scenario_passes_grader(testcases_dir, scenario):
+    params = Params.from_file(str(testcases_dir / f"{scenario}.conf"))
+    params.BACKEND = "tpu_sparse"
+    result = get_backend("tpu_sparse")(params, seed=3)
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
+
+
+def test_removal_latency_in_reference_window(testcases_dir):
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = "tpu_sparse"
+    lat = removal_latencies(
+        get_backend("tpu_sparse")(params, seed=3).log.dbg_text(), 100)
+    assert len(lat) == 9
+    assert set(lat) <= {21, 22, 23}, lat
+
+
+def _scale_run(n=128, m=16, g=8, probes=5, total=150, fail_time=100, seed=0,
+               extra=""):
+    p = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {m}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
+        f"TOTAL_TIME: {total}\nFAIL_TIME: {fail_time}\n"
+        f"JOIN_MODE: warm\nBACKEND: tpu_sparse\n" + extra)
+    plan = make_plan(p, random.Random(f"app:{seed}"))
+    final_state, events = run_scan(p, plan, seed=seed)
+    return p, plan, final_state, events
+
+
+def test_bounded_view_failure_detection_no_false_positives():
+    p, plan, fs, ev = _scale_run()
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    true_lat, false_rm = [], []
+    for t, i, s in zip(*np.nonzero(rm != -1)):
+        if rm[t, i, s] == failed and t > plan.fail_time:
+            true_lat.append(int(t) - plan.fail_time)
+        else:
+            false_rm.append((int(t), int(i), int(rm[t, i, s])))
+    assert not false_rm, false_rm[:10]
+    # The failed node was tracked by ~VIEW_SIZE peers; they all must detect.
+    assert len(true_lat) >= p.VIEW_SIZE // 2, true_lat
+    # Latency stays O(TREMOVE), independent of N (the SWIM property).
+    assert max(true_lat) <= p.TREMOVE + p.VIEW_SIZE // p.PROBES + 5, true_lat
+    assert min(true_lat) >= p.TFAIL, true_lat
+
+
+def test_bounded_view_rack_failure():
+    # Correlated rack failure: every member of 2 racks crashes at once.
+    p, plan, fs, ev = _scale_run(
+        n=128, total=150, fail_time=100,
+        extra="RACK_SIZE: 8\nRACK_FAILURES: 2\n")
+    assert plan.kind == "racks" and len(plan.failed_indices) == 16
+    rm = np.asarray(ev.rm_ids)
+    failed = set(plan.failed_indices)
+    detections = set()
+    for t, i, s in zip(*np.nonzero(rm != -1)):
+        assert rm[t, i, s] in failed, (t, i, rm[t, i, s])
+        assert t > plan.fail_time
+        detections.add(int(rm[t, i, s]))
+    # Most crashed nodes are detected by someone (all that were in views).
+    assert len(detections) >= 12, (len(detections), sorted(detections))
+
+
+def test_view_size_bounds_state():
+    p, plan, fs, ev = _scale_run(n=128, m=8, g=4, probes=4)
+    sid = np.asarray(fs.slot_id)
+    assert sid.shape == (128, 8)
+    # Views are full (8 members tracked) and include self.
+    occ = (sid != -1).sum(1)
+    assert occ.min() >= 4
+    alive = np.asarray(~np.asarray(fs.failed))
+    has_self = sid == np.arange(128)[:, None]
+    assert bool(has_self.any(1)[alive].all())
+
+
+def test_msgdrop_window_tolerated():
+    # 10% drops during the window; detector still converges afterwards.
+    p, plan, fs, ev = _scale_run(
+        n=128, total=150, fail_time=100, seed=1,
+        extra="DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 20\nDROP_STOP: 80\n")
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    true_det = sum(
+        1 for t, i, s in zip(*np.nonzero(rm != -1))
+        if rm[t, i, s] == failed and t > plan.fail_time)
+    assert true_det >= p.VIEW_SIZE // 2
+
+
+def test_staggered_join_with_bounded_views(testcases_dir):
+    # Introducer-based join still works when the view cannot hold everyone.
+    p = Params.from_text(
+        "MAX_NNB: 40\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 12\nGOSSIP_LEN: 6\nPROBES: 4\nTOTAL_TIME: 60\n"
+        "FAIL_TIME: 1000\nBACKEND: tpu_sparse\n")
+    p.SINGLE_FAILURE = 0
+    plan = make_plan(p, random.Random("app:0"))
+    plan.fail_time = None  # no failure injection
+    final_state, events = run_scan(p, plan, seed=0)
+    in_group = np.asarray(final_state.in_group)
+    assert in_group.all(), np.nonzero(~in_group)
+    sid = np.asarray(final_state.slot_id)
+    assert ((sid != -1).sum(1) >= 6).all()
