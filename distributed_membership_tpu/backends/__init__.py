@@ -11,7 +11,9 @@ Backends:
   * ``emul_native`` — same semantics, C++ core via ctypes;
   * ``tpu``         — dense vectorized jitted step under ``lax.scan``;
   * ``tpu_sharded`` — node axis sharded over a device mesh (shard_map);
-  * ``tpu_sparse``  — bounded member views for large N (hash-slotted).
+  * ``tpu_sparse``  — exact bounded member views (sorted merge);
+  * ``tpu_hash``    — hash-slotted bounded views, elementwise-max merge:
+    the high-throughput scale path.
 """
 
 from __future__ import annotations
@@ -62,6 +64,7 @@ _MODULES = {
     "tpu": "distributed_membership_tpu.backends.tpu",
     "tpu_sharded": "distributed_membership_tpu.backends.tpu_sharded",
     "tpu_sparse": "distributed_membership_tpu.backends.tpu_sparse",
+    "tpu_hash": "distributed_membership_tpu.backends.tpu_hash",
 }
 
 

@@ -1,0 +1,180 @@
+"""`emul_native` backend: the host simulator core in C++.
+
+The reference's runtime is native (C++ end to end); this backend keeps that
+property for the rebuild's host path: the entire tick loop — network buffer,
+protocol, sweep, gossip — runs inside ``native/emul_engine.cpp`` (see its
+header comment for the design deltas vs. the reference), compiled on first
+use with the system g++ and loaded through ctypes.  Python retains what
+Python owns: config parsing, failure planning, the dbg.log format contract
+(eventlog.py), and grading.
+
+The engine streams (joined/removed) protocol events back in one buffer;
+this wrapper replays them through :class:`EventLog` interleaved with the
+driver-level lines (APP, Starting up group/Trying to join, @@time beacons,
+failure notices) so the log line inventory matches the `emul` backend's.
+
+Throughput: ~40x the pure-Python `emul` backend on the 10-node grader
+scenarios (measured in-tree), making it the preferred oracle for sweeps.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import random as _pyrandom
+import subprocess
+import threading
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.runtime.failures import log_failures, make_plan
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "emul_engine.cpp")
+_SO = os.path.join(_NATIVE_DIR, "build", "libemul_engine.so")
+_LOCK = threading.Lock()
+_LIB = None
+
+
+class DmConfig(ctypes.Structure):
+    _fields_ = [
+        ("n", ctypes.c_int32), ("total_time", ctypes.c_int32),
+        ("tfail", ctypes.c_int32), ("tremove", ctypes.c_int32),
+        ("fanout", ctypes.c_int32), ("fail_time", ctypes.c_int32),
+        ("drop_start", ctypes.c_int32), ("drop_stop", ctypes.c_int32),
+        ("drop_pct", ctypes.c_int32),
+        ("en_buffsize", ctypes.c_int64), ("max_msg_size", ctypes.c_int64),
+        ("join_mode", ctypes.c_int32),
+        ("step_rate", ctypes.c_double), ("seed", ctypes.c_uint64),
+    ]
+
+
+def _build() -> str:
+    """Compile the engine if the .so is missing or older than the source."""
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", _SO, _SRC]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native engine build failed:\n{proc.stderr}")
+    return _SO
+
+
+def _lib():
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build())
+            lib.dm_run.restype = ctypes.c_int
+            lib.dm_run.argtypes = [
+                ctypes.POINTER(DmConfig),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            _LIB = lib
+    return _LIB
+
+
+def _as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+@register("emul_native")
+def run_emul_native(params: Params, log: Optional[EventLog] = None,
+                    seed: Optional[int] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    # Same failure-plan RNG stream as every other backend: identical seeds
+    # crash identical nodes across backends.
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    n = params.EN_GPSZ
+    total = params.TOTAL_TIME
+    cfg = DmConfig(
+        n=n, total_time=total, tfail=params.TFAIL, tremove=params.TREMOVE,
+        fanout=params.FANOUT,
+        fail_time=plan.fail_time if plan.fail_time is not None else -1,
+        drop_start=plan.drop_start if plan.drop_start is not None else -1,
+        drop_stop=plan.drop_stop if plan.drop_stop is not None else -1,
+        drop_pct=int(params.MSG_DROP_PROB * 100) if params.DROP_MSG else 0,
+        en_buffsize=params.EN_BUFFSIZE, max_msg_size=params.MAX_MSG_SIZE,
+        join_mode=1 if params.JOIN_MODE == "batch" else 0,
+        step_rate=params.STEP_RATE, seed=seed & (2**64 - 1),
+    )
+
+    fail_mask = np.zeros((n,), dtype=np.uint8)
+    if plan.fail_time is not None:
+        fail_mask[plan.failed_indices] = 1
+    sent = np.zeros((n, total), dtype=np.int32)
+    recv = np.zeros((n, total), dtype=np.int32)
+    # joins are bounded by n per logger view + churn; removes likewise.
+    events_cap = 4 * n * n + 4096
+    events = np.zeros((events_cap, 4), dtype=np.int32)
+    n_events = ctypes.c_int64(0)
+
+    rc = _lib().dm_run(
+        ctypes.byref(cfg), _as_ptr(fail_mask, ctypes.c_uint8),
+        _as_ptr(sent, ctypes.c_int32), _as_ptr(recv, ctypes.c_int32),
+        _as_ptr(events, ctypes.c_int32), events_cap, ctypes.byref(n_events))
+    if rc != 0:
+        raise RuntimeError("native engine event buffer overflowed")
+
+    _replay_log(params, plan, events[:n_events.value], log)
+
+    return RunResult(
+        params=params, log=log, sent=sent, recv=recv,
+        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
+        fail_time=plan.fail_time,
+        wall_seconds=_time.time() - t0,
+        extra={"native": True},
+    )
+
+
+def _replay_log(params: Params, plan, events: np.ndarray,
+                log: EventLog) -> None:
+    """Interleave engine events with the driver-level lines, matching the
+    `emul` backend's inventory (Application.cpp:67,143-148,156-160,184,192)."""
+    n = params.EN_GPSZ
+    starts = [params.start_tick(i) for i in range(n)]
+    for i in range(n):
+        log.log(i + 1, 0, "APP")
+
+    by_tick: dict = {}
+    for kind, logger, subject, tick in events:
+        by_tick.setdefault(int(tick), []).append(
+            (int(kind), int(logger), int(subject)))
+
+    intro_failed = (plan.fail_time is not None
+                    and INTRODUCER_INDEX in plan.failed_indices)
+    for t in range(params.TOTAL_TIME):
+        for i in range(n - 1, -1, -1):
+            if starts[i] == t:
+                if i == INTRODUCER_INDEX:
+                    log.log(i + 1, t, "Starting up group...")
+                else:
+                    log.log(i + 1, t, "Trying to join...")
+        for kind, logger, subject, in by_tick.get(t, ()):
+            if kind == 0:
+                log.node_add(logger, subject, t)
+            else:
+                log.node_remove(logger, subject, t)
+        if (t % 500 == 0 and t > starts[INTRODUCER_INDEX]
+                and not (intro_failed and t > plan.fail_time)):
+            log.log(INTRODUCER_INDEX + 1, t, f"@@time={t}")
+        if plan.fail_time == t:
+            log_failures(plan, log, t)

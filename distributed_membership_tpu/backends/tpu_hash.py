@@ -1,0 +1,472 @@
+"""`tpu_hash` backend: hash-slotted member views — the high-throughput
+scale path.
+
+**The design insight.** The dense `tpu` backend's ``[N, N]`` state is a
+member table with a *perfect* hash (column = member id) whose merge is an
+elementwise max.  The sorted `tpu_sparse` backend bounds memory but pays for
+exact bounded-membership semantics with batched sorts — O(S log^2 S) bitonic
+passes per tick that burn HBM bandwidth (measured ~15 GB/tick at N=4096).
+This backend keeps the dense backend's *shape* and bounds memory by making
+the hash lossy: node ``i`` stores member ``id`` at slot
+``h_i(id) = (id + i * STRIDE) mod S`` in a ``[N, S]`` table of uint32-packed
+``(heartbeat, id)`` entries, and the per-receiver mailbox uses the *same*
+slot map — so delivery + merge collapse into ONE elementwise ``max``:
+
+    view' = max(view, mail)        # the whole receive path, pure VPU
+
+Per-id semantics are the reference's exactly (max heartbeat wins; local
+timestamp refreshes only on strict increase, MP1Node.cpp:278-288), because
+packing puts the heartbeat in the high bits.  When ``S >= N`` the slot map
+is injective and the protocol is the dense backend's (modulo a per-row
+column permutation).
+
+**Admission control — why a slot is never stolen.**  When ``S < N``, far
+more ids circulate through gossip than a view can hold.  If the slot
+combine were a blind heartbeat max, a failed member (frozen heartbeat)
+would be silently evicted by any colliding live id long before its TREMOVE
+deadline and the detector would log nothing.  So occupancy is sticky: an
+occupied slot accepts only updates for its *current occupant's id*; new
+ids are admitted only into empty slots; the only eviction is the TREMOVE
+sweep itself (which frees the slot for churn).  Each node therefore tracks
+a stable ~S-member random subset — exactly the fixed partial list the spec
+permits, with clean join/remove events and full per-view detection
+completeness.
+
+Two delivery channels with different reliability by construction:
+  * gossip/mailbox (``mail``): scatter-max per receiver slot; collisions
+    between different ids can drop a message — best-effort discovery;
+  * acks (``amail``): slot-addressed by the probed id.  Probed ids are view
+    occupants and occupants have distinct slots, so this channel is
+    collision-free — entry *refresh* (what false-positive avoidance
+    depends on) never competes with gossip volume.
+
+Failure detection at scale uses the same SWIM round-robin probe/ack scheme
+as `tpu_sparse` (see its docstring for why bounded gossip alone cannot
+work): every occupied slot is pinged once per ``ceil(S/PROBES)`` ticks, so
+TFAIL/TREMOVE must be sized in units of that cycle — the SWIM protocol
+period, now decoupled from N.
+
+Everything is [N, S]-elementwise ops, one scatter-max for sends, and one
+top_k for target sampling — no sorts, no data-dependent shapes.  Per-tick
+HBM traffic is ~6 passes over [N, S] u32: ~0.9 GB at N=1M, S=128.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _pyrandom
+import time as _time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.backends.tpu_sparse import (
+    SEED_CAP, SparseTickEvents, events_to_log)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.ops.sampling import sample_k_indices
+from distributed_membership_tpu.ops.view_merge import EMPTY
+from distributed_membership_tpu.runtime.failures import (
+    FailurePlan, log_failures, make_plan, plan_tensors)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+STRIDE = 7919  # odd prime: per-node slot-map offset decorrelates which id
+#                pairs collide across different nodes' views
+
+
+class HashState(NamedTuple):
+    view: jax.Array      # [N, S] u32 packed (hb * N + id + 1), 0 = empty
+    view_ts: jax.Array   # [N, S] i32 — tick of last strict packed increase
+    started: jax.Array   # [N] bool
+    in_group: jax.Array  # [N] bool
+    failed: jax.Array    # [N] bool
+    self_hb: jax.Array   # [N] i32
+    mail: jax.Array      # [N, S] u32 — receiver-slot-mapped, max-combined
+    amail: jax.Array     # [N, S] u32 — ack channel, collision-free (docstring)
+    pmail: jax.Array     # [N, Qp] u32 probe mailbox (prober id + 1)
+    joinreq_infl: jax.Array  # [N] bool
+    joinrep_infl: jax.Array  # [N] bool
+    pending_recv: jax.Array  # [N] i32
+
+
+@dataclasses.dataclass(frozen=True)
+class HashConfig:
+    n: int
+    s: int           # view/mailbox slots per node
+    g: int           # entries piggybacked per gossip message
+    tfail: int
+    tremove: int
+    fanout: int
+    drop_prob: float
+    probes: int = 0
+    qp: int = 16
+    seed_cap: int = SEED_CAP
+    collect_events: bool = True
+
+
+def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
+    """The per-node slot map h_node(member)."""
+    return jax.lax.rem(member + node * STRIDE, cfg.s)
+
+
+def pack(cfg: HashConfig, hb: jax.Array, member: jax.Array) -> jax.Array:
+    return (hb.astype(U32) * U32(cfg.n) + member.astype(U32) + U32(1))
+
+
+def unpack(cfg: HashConfig, packed: jax.Array):
+    """→ (member id [EMPTY if none], hb, present)."""
+    present = packed > 0
+    v = packed - U32(1)
+    member = (v % U32(cfg.n)).astype(I32)
+    hb = (v // U32(cfg.n)).astype(I32)
+    return jnp.where(present, member, EMPTY), jnp.where(present, hb, -1), present
+
+
+def _scatter_msgs(cfg: HashConfig, mail: jax.Array, tgt: jax.Array,
+                  msg_id: jax.Array, msg_hb: jax.Array,
+                  msg_valid: jax.Array) -> jax.Array:
+    """Max-combine messages into receiver-slot-mapped mailboxes."""
+    n, s = mail.shape
+    addr = tgt * s + slot_of(cfg, tgt, msg_id)
+    addr = jnp.where(msg_valid, addr, n * s).reshape(-1)
+    val = jnp.where(msg_valid, pack(cfg, msg_hb, msg_id), 0).reshape(-1)
+    flat = mail.reshape(-1).at[addr].max(val, mode="drop")
+    return flat.reshape(n, s)
+
+
+def init_state(cfg: HashConfig) -> HashState:
+    n, s = cfg.n, cfg.s
+    return HashState(
+        view=jnp.zeros((n, s), U32),
+        view_ts=jnp.zeros((n, s), I32),
+        started=jnp.zeros((n,), bool),
+        in_group=jnp.zeros((n,), bool),
+        failed=jnp.zeros((n,), bool),
+        self_hb=jnp.zeros((n,), I32),
+        mail=jnp.zeros((n, s), U32),
+        amail=jnp.zeros((n, s), U32),
+        pmail=jnp.zeros((n, cfg.qp), U32),
+        joinreq_infl=jnp.zeros((n,), bool),
+        joinrep_infl=jnp.zeros((n,), bool),
+        pending_recv=jnp.zeros((n,), I32),
+    )
+
+
+def init_state_warm(cfg: HashConfig, key: jax.Array) -> HashState:
+    """Every node in-group at t=0 with self + ~S/2 random neighbors."""
+    n, s = cfg.n, cfg.s
+    st = init_state(cfg)
+    idx = jnp.arange(n, dtype=I32)
+    fill = max(s // 2, 1)
+    offs = jax.random.randint(key, (n, fill), 1, max(n, 2), dtype=I32)
+    nbrs = jax.lax.rem(idx[:, None] + offs, n)
+    view = _scatter_msgs(
+        cfg, st.view, jnp.broadcast_to(idx[:, None], nbrs.shape), nbrs,
+        jnp.zeros_like(nbrs), jnp.ones(nbrs.shape, bool))
+    view = view.at[idx, slot_of(cfg, idx, idx)].max(
+        pack(cfg, jnp.zeros((n,), I32), idx))
+    return st._replace(
+        view=view,
+        started=jnp.ones((n,), bool),
+        in_group=jnp.ones((n,), bool),
+    )
+
+
+def make_step(cfg: HashConfig):
+    """Per-tick transition; same pass structure as the dense backend
+    (backends/tpu.py) with hashed coordinates.  Pure/jittable."""
+    n, s, g = cfg.n, cfg.s, cfg.g
+    intro = INTRODUCER_INDEX
+    idx = jnp.arange(n, dtype=I32)
+    k_max = min(cfg.fanout, s)
+
+    def step(state: HashState, inputs):
+        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        k_targets, k_entries, k_drop, k_ctrl, k_drop_p = jax.random.split(key, 5)
+
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+        if cfg.drop_prob > 0.0:
+            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+                          & drop_active)
+        else:
+            ctrl_kept = jnp.ones((2, n), bool)
+
+        # ---- pass 1: receive = elementwise admit-or-refresh combine ----
+        # Occupied slots accept only their occupant's id (sticky admission,
+        # module docstring); empty slots admit the incoming winner.  Acks
+        # apply first: their channel is collision-free, and an occupant
+        # whose slot the gossip winner contends for still gets its refresh.
+        recv_mask = state.started & (t > start_ticks) & ~state.failed
+        rcol = recv_mask[:, None]
+        prev_id, _, prev_present = unpack(cfg, state.view)
+
+        def admit(view, incoming):
+            in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
+            occupied = view > 0
+            matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+            take = (incoming > 0) & (~occupied | matches)
+            return jnp.where(take, jnp.maximum(view, incoming), view)
+
+        view = jnp.where(rcol, admit(state.view, state.amail), state.view)
+        view = jnp.where(rcol, admit(view, state.mail), view)
+        changed = view > state.view
+        view_ts = jnp.where(changed, t, state.view_ts)
+        mail = jnp.where(rcol, 0, state.mail)
+        amail = jnp.where(rcol, 0, state.amail)
+
+        cur_id, cur_hb, present = unpack(cfg, view)
+        join_mask = changed & ~prev_present  # admission into an empty slot
+        join_ids = jnp.where(join_mask, cur_id, EMPTY)
+
+        # Probe mailbox stores bare prober ids (id + 1, 0 = empty).
+        ack_valid = (state.pmail > 0) & recv_mask[:, None]
+        ack_tgt = jnp.where(ack_valid, state.pmail.astype(I32) - 1, 0)
+        pmail = jnp.where(recv_mask[:, None], 0, state.pmail)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        in_group = state.in_group | (state.joinrep_infl & recv_mask)
+        joinrep_infl = state.joinrep_infl & ~recv_mask
+
+        seeds = state.joinreq_infl & recv_mask[intro]
+        joinreq_infl = state.joinreq_infl & ~recv_mask[intro]
+        rep_ok = seeds & ctrl_kept[1]
+        joinrep_infl = joinrep_infl | rep_ok
+        n_seeds = seeds.sum(dtype=I32)
+        sent_rep = jnp.where(idx == intro,
+                             jnp.where(recv_mask[intro], rep_ok.sum(dtype=I32), 0), 0)
+        pending_recv = pending_recv + rep_ok.astype(I32)
+
+        # ---- nodeStart ----
+        start_now = t == start_ticks
+        started = state.started | start_now
+        boot = start_now[intro]
+        in_group = in_group.at[intro].set(in_group[intro] | boot)
+
+        joiner_req = start_now & (idx != intro) & ctrl_kept[0]
+        joinreq_infl = joinreq_infl | joiner_req
+        mail = _scatter_msgs(cfg, mail, jnp.full((n,), intro, I32), idx,
+                             jnp.zeros((n,), I32), joiner_req)
+        pending_recv = pending_recv.at[intro].add(joiner_req.sum(dtype=I32))
+        sent_req = joiner_req.astype(I32)
+
+        # ---- self refresh (double heartbeat increment, MP1Node.cpp:412-415) --
+        act = started & (t > start_ticks) & ~state.failed & in_group
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_on = act | ((idx == intro) & boot)
+        self_slot = slot_of(cfg, idx, idx)
+        self_val = pack(cfg, jnp.where(act, own_hb, 0), idx)
+        old_self = view[idx, self_slot]
+        view = view.at[idx, self_slot].set(
+            jnp.where(self_on, self_val, old_self))
+        view_ts = view_ts.at[idx, self_slot].set(
+            jnp.where(self_on, t, view_ts[idx, self_slot]))
+        cur_id, cur_hb, present = unpack(cfg, view)
+
+        # ---- TFAIL / TREMOVE sweep ----
+        difft = t - view_ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        rm_ids = jnp.where(removes, cur_id, EMPTY)
+        view = jnp.where(removes, 0, view)
+        present = present & ~removes
+
+        # ---- gossip ----
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = cur_id == idx[:, None]
+        eligible = fresh & ~is_self_slot & act[:, None]
+        in_seed = seeds[jnp.clip(cur_id, 0)] & present
+        eligible = eligible.at[intro].set(eligible[intro] & ~in_seed[intro])
+        seed_burst_on = act[intro]
+        n_seeds_row = jnp.where((idx == intro) & seed_burst_on, n_seeds, 0)
+        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        tgt_slot, tgt_valid = sample_k_indices(k_targets, eligible, k_extra, k_max)
+        tgt = jnp.take_along_axis(cur_id, tgt_slot, axis=1)
+
+        if g >= s:
+            e_ids, e_hbs, e_valid = cur_id, cur_hb, fresh
+        else:
+            scores = jnp.where(is_self_slot, -1.0,
+                               jax.random.uniform(k_entries, (n, s)))
+            scores = jnp.where(fresh, scores, 2.0)
+            _, e_idx = jax.lax.top_k(-scores, g)
+            e_valid = jnp.take_along_axis(fresh, e_idx, axis=1)
+            e_ids = jnp.take_along_axis(cur_id, e_idx, axis=1)
+            e_hbs = jnp.take_along_axis(cur_hb, e_idx, axis=1)
+        g_eff = e_ids.shape[1]
+
+        msg_valid = tgt_valid[:, :, None] & e_valid[:, None, :]
+        if cfg.drop_prob > 0.0:
+            k_drop_f, k_drop_s = jax.random.split(k_drop)
+            dropped = jax.random.bernoulli(k_drop_f, cfg.drop_prob,
+                                           (n, k_max, g_eff))
+            msg_valid = msg_valid & ~(dropped & drop_active)
+        else:
+            k_drop_s = k_drop
+        tgt_b = jnp.broadcast_to(tgt[:, :, None], (n, k_max, g_eff))
+        mail = _scatter_msgs(
+            cfg, mail, tgt_b,
+            jnp.broadcast_to(e_ids[:, None, :], (n, k_max, g_eff)),
+            jnp.broadcast_to(e_hbs[:, None, :], (n, k_max, g_eff)), msg_valid)
+        sent_tick = msg_valid.sum((1, 2), dtype=I32) + sent_req + sent_rep
+        recv_add = jnp.zeros((n + 1,), I32).at[
+            jnp.where(tgt_valid, tgt, n).reshape(-1)
+        ].add(msg_valid.sum(2, dtype=I32).reshape(-1), mode="drop")[:n]
+
+        # Introducer burst to this tick's joiners (full fresh view).
+        _, seed_idx = jax.lax.top_k(seeds.astype(I32), min(cfg.seed_cap, n))
+        seed_valid = seeds[seed_idx] & seed_burst_on
+        burst_valid = seed_valid[:, None] & fresh[intro][None, :]
+        if cfg.drop_prob > 0.0:
+            dropped = jax.random.bernoulli(k_drop_s, cfg.drop_prob,
+                                           (seed_idx.shape[0], s))
+            burst_valid = burst_valid & ~(dropped & drop_active)
+        mail = _scatter_msgs(
+            cfg, mail, jnp.broadcast_to(seed_idx[:, None], burst_valid.shape),
+            jnp.broadcast_to(cur_id[intro][None, :], burst_valid.shape),
+            jnp.broadcast_to(cur_hb[intro][None, :], burst_valid.shape),
+            burst_valid)
+        sent_tick = sent_tick.at[intro].add(burst_valid.sum(dtype=I32))
+        recv_add = recv_add.at[seed_idx].add(
+            burst_valid.sum(1, dtype=I32) * seed_valid.astype(I32))
+
+        # ---- SWIM round-robin probing (see tpu_sparse docstring) ----
+        if cfg.probes > 0:
+            ptr = jax.lax.rem(t * cfg.probes, s)
+            off = jax.lax.rem(jnp.arange(s, dtype=I32) - ptr + 2 * s, s)
+            sweep = off < cfg.probes
+            p_valid = sweep[None, :] & present & ~is_self_slot & act[:, None]
+            p_tgt = jnp.where(p_valid, cur_id, EMPTY)
+            ack_ok = ack_valid & act[:, None]
+            if cfg.drop_prob > 0.0:
+                kd1, kd2 = jax.random.split(k_drop_p)
+                p_valid = p_valid & ~(jax.random.bernoulli(
+                    kd1, cfg.drop_prob, p_valid.shape) & drop_active)
+                ack_ok = ack_ok & ~(jax.random.bernoulli(
+                    kd2, cfg.drop_prob, ack_ok.shape) & drop_active)
+            own_id_p = jnp.broadcast_to(idx[:, None], p_tgt.shape)
+            own_hb_p = jnp.broadcast_to(own_hb[:, None], p_tgt.shape)
+            # Probe: prober id into target's probe mailbox (salted hash) +
+            # prober's own entry piggybacked into the gossip mailbox.
+            qp = cfg.qp
+            paddr = p_tgt * qp + jax.lax.rem(own_id_p + t, qp)
+            paddr = jnp.where(p_valid, paddr, n * qp).reshape(-1)
+            pval = jnp.where(p_valid, own_id_p.astype(U32) + U32(1), 0).reshape(-1)
+            pmail = pmail.reshape(-1).at[paddr].max(pval, mode="drop").reshape(n, qp)
+            mail = _scatter_msgs(cfg, mail, p_tgt, own_id_p, own_hb_p, p_valid)
+            # Ack: my (id, current hb) into each prober's ack channel — lands
+            # at the prober's slot for me, the exact entry the probe
+            # refreshes, with no gossip contention (module docstring).
+            amail = _scatter_msgs(
+                cfg, amail, ack_tgt, jnp.broadcast_to(idx[:, None], ack_tgt.shape),
+                jnp.broadcast_to(own_hb[:, None], ack_tgt.shape), ack_ok)
+            sent_tick = (sent_tick + p_valid.sum(1, dtype=I32)
+                         + ack_ok.sum(1, dtype=I32))
+            recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
+                jnp.where(p_valid, p_tgt, n).reshape(-1)].add(1, mode="drop")[:n]
+            recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
+                jnp.where(ack_ok, ack_tgt, n).reshape(-1)].add(1, mode="drop")[:n]
+
+        pending_recv = pending_recv + recv_add
+
+        failed = state.failed | (fail_mask & (t == fail_time))
+
+        new_state = HashState(view, view_ts, started, in_group, failed,
+                              self_hb, mail, amail, pmail, joinreq_infl,
+                              joinrep_infl, pending_recv)
+        if cfg.collect_events:
+            out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
+        else:
+            out = SparseTickEvents((join_ids != EMPTY).sum(dtype=I32),
+                                   (rm_ids != EMPTY).sum(dtype=I32),
+                                   sent_tick, recv_tick)
+        return new_state, out
+
+    return step
+
+
+def make_config(params: Params, collect_events: bool = True) -> HashConfig:
+    n = params.EN_GPSZ
+    s = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
+    g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else s
+    params.validate_sparse_packing()
+    qp = n if n <= 1024 else max(16, 8 * params.PROBES)
+    seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
+    return HashConfig(
+        n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
+        fanout=params.FANOUT,
+        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        probes=params.PROBES, qp=qp, seed_cap=seed_cap,
+        collect_events=collect_events)
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def _get_runner(cfg: HashConfig, warm: bool):
+    cache_key = (cfg, warm)
+    if cache_key not in _RUNNER_CACHE:
+        step = make_step(cfg)
+
+        def run(keys, ticks, start_ticks, fail_mask, fail_time,
+                drop_lo, drop_hi, warm_key):
+            state0 = (init_state_warm(cfg, warm_key) if warm
+                      else init_state(cfg))
+
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask,
+                                    fail_time, drop_lo, drop_hi))
+
+            return jax.lax.scan(body, state0, (ticks, keys))
+
+        _RUNNER_CACHE[cache_key] = jax.jit(run)
+    return _RUNNER_CACHE[cache_key]
+
+
+def run_scan(params: Params, plan: FailurePlan, seed: int,
+             collect_events: bool = True, total_time: Optional[int] = None):
+    """Run the full simulation; returns (final_state, events)."""
+    cfg = make_config(params, collect_events)
+    total = total_time if total_time is not None else params.TOTAL_TIME
+    warm = params.JOIN_MODE == "warm"
+
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
+
+    run = _get_runner(cfg, warm)
+    final_state, events = run(
+        keys, ticks, start_ticks, fail_mask, fail_time, drop_lo, drop_hi,
+        jax.random.PRNGKey(seed ^ 0x5EED))
+    return final_state, jax.tree.map(np.asarray, events)
+
+
+@register("tpu_hash")
+def run_tpu_hash(params: Params, log: Optional[EventLog] = None,
+                 seed: Optional[int] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    final_state, events = run_scan(params, plan, seed)
+    events_to_log(params, plan, events, log)
+
+    return RunResult(
+        params=params, log=log,
+        sent=np.asarray(events.sent).T, recv=np.asarray(events.recv).T,
+        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
+        fail_time=plan.fail_time,
+        wall_seconds=_time.time() - t0,
+        extra={"final_state": final_state},
+    )

@@ -28,7 +28,8 @@ DEFAULT_FANOUT = 5
 DEFAULT_EN_BUFFSIZE = 30000
 DEFAULT_PORTNUM = 8001  # Params.cpp:12 (unused for addressing: ENinit forces port 0)
 
-_KNOWN_BACKENDS = ("emul", "emul_native", "tpu", "tpu_sharded", "tpu_sparse")
+_KNOWN_BACKENDS = ("emul", "emul_native", "tpu", "tpu_sharded", "tpu_sparse",
+                   "tpu_hash")
 
 
 @dataclasses.dataclass
@@ -151,7 +152,8 @@ class Params:
         if self.JOIN_MODE not in ("staggered", "batch", "warm"):
             raise ValueError(
                 f"JOIN_MODE must be staggered|batch|warm, got {self.JOIN_MODE!r}")
-        if self.JOIN_MODE == "warm" and self.BACKEND not in ("tpu_sparse",):
+        if self.JOIN_MODE == "warm" and self.BACKEND not in ("tpu_sparse",
+                                                             "tpu_hash"):
             # Warm bootstrap needs backend support (pre-seeded views); on the
             # introducer-join backends a -1 start tick would silently
             # simulate nothing.

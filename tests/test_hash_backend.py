@@ -1,0 +1,122 @@
+"""`tpu_hash` backend: parity + scale-regime correctness.
+
+Mirrors tests/test_sparse_backend.py for the hash-slotted scale backend,
+plus hash-specific properties: sticky slot admission (no silent eviction)
+and the S >= N exactness regime (injective slot map ⇒ dense-backend
+semantics; backends/tpu_hash.py docstring).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.backends.tpu_hash import run_scan
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import grade_scenario
+from distributed_membership_tpu.observability.metrics import removal_latencies
+from distributed_membership_tpu.runtime.failures import make_plan
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_scenario_passes_grader(testcases_dir, scenario):
+    params = Params.from_file(str(testcases_dir / f"{scenario}.conf"))
+    params.BACKEND = "tpu_hash"
+    result = get_backend("tpu_hash")(params, seed=3)
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
+
+
+def test_removal_latency_in_reference_window(testcases_dir):
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = "tpu_hash"
+    lat = removal_latencies(
+        get_backend("tpu_hash")(params, seed=3).log.dbg_text(), 100)
+    assert len(lat) == 9
+    assert set(lat) <= {21, 22, 23}, lat
+
+
+def _scale_run(n=256, s=32, g=8, probes=8, tfail=10, tremove=30,
+               total=150, fail_time=100, seed=0, extra=""):
+    # Probe cycle = ceil(S/PROBES) ticks; TFAIL/TREMOVE sized in cycles.
+    p = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
+        f"TFAIL: {tfail}\nTREMOVE: {tremove}\n"
+        f"TOTAL_TIME: {total}\nFAIL_TIME: {fail_time}\n"
+        f"JOIN_MODE: warm\nBACKEND: tpu_hash\n" + extra)
+    plan = make_plan(p, random.Random(f"app:{seed}"))
+    final_state, events = run_scan(p, plan, seed=seed)
+    return p, plan, final_state, events
+
+
+def test_scale_detection_no_false_positives():
+    p, plan, fs, ev = _scale_run()
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    true_lat, false_rm = [], []
+    for t, i, s in zip(*np.nonzero(rm != -1)):
+        if rm[t, i, s] == failed and t > plan.fail_time:
+            true_lat.append(int(t) - plan.fail_time)
+        else:
+            false_rm.append((int(t), int(i), int(rm[t, i, s])))
+    assert not false_rm, false_rm[:10]
+    # ~S viewers track the failed node; they all detect at ~TREMOVE.
+    assert len(true_lat) >= p.VIEW_SIZE // 2, len(true_lat)
+    cycle = -(-p.VIEW_SIZE // p.PROBES)
+    assert max(true_lat) <= p.TREMOVE + 4 * cycle, sorted(true_lat)[-5:]
+    assert min(true_lat) >= p.TFAIL, sorted(true_lat)[:5]
+
+
+def test_sticky_admission_views_are_stable():
+    # In a failure-free steady state, views must not churn: the occupant
+    # set at mid-run equals the occupant set at the end (no silent
+    # eviction — the property a blind heartbeat-max combine lacks).
+    p = Params.from_text(
+        "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 32\nGOSSIP_LEN: 8\nPROBES: 8\nTFAIL: 10\nTREMOVE: 30\n"
+        "TOTAL_TIME: 120\nFAIL_TIME: 1000\nJOIN_MODE: warm\nBACKEND: tpu_hash\n")
+    plan = make_plan(p, random.Random("app:0"))
+    plan.fail_time = None
+    _, ev = run_scan(p, plan, seed=0)
+    rm = np.asarray(ev.rm_ids)
+    assert (rm == -1).all(), np.argwhere(rm != -1)[:5]
+    joins = np.asarray(ev.join_ids)
+    # Joins happen only while views fill (early); none after convergence.
+    late_joins = (joins[60:] != -1).sum()
+    assert late_joins == 0, late_joins
+
+
+def test_rack_failure_detected():
+    p, plan, fs, ev = _scale_run(
+        n=256, total=200, fail_time=120,
+        extra="RACK_SIZE: 16\nRACK_FAILURES: 2\n")
+    assert plan.kind == "racks" and len(plan.failed_indices) == 32
+    rm = np.asarray(ev.rm_ids)
+    failed = set(plan.failed_indices)
+    detections = set()
+    for t, i, s in zip(*np.nonzero(rm != -1)):
+        assert rm[t, i, s] in failed
+        assert t > plan.fail_time
+        detections.add(int(rm[t, i, s]))
+    # Every crashed node was tracked by someone and detected.
+    assert len(detections) >= 28, len(detections)
+
+
+def test_drop_window_tolerated():
+    p, plan, fs, ev = _scale_run(
+        total=200, fail_time=140, seed=1,
+        extra="DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 20\nDROP_STOP: 120\n")
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    true_det = sum(
+        1 for t, i, s in zip(*np.nonzero(rm != -1))
+        if rm[t, i, s] == failed and t > plan.fail_time)
+    false_det = sum(
+        1 for t, i, s in zip(*np.nonzero(rm != -1))
+        if rm[t, i, s] != failed or t <= plan.fail_time)
+    assert true_det >= p.VIEW_SIZE // 2
+    # 10% loss is within the probe/ack redundancy margin: no false removals.
+    assert false_det == 0, false_det
