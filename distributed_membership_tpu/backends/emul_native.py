@@ -20,6 +20,7 @@ scenarios (measured in-tree), making it the preferred oracle for sweeps.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import random as _pyrandom
 import subprocess
@@ -56,17 +57,31 @@ class DmConfig(ctypes.Structure):
     ]
 
 
+def _src_digest() -> str:
+    with open(_SRC, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
 def _build() -> str:
-    """Compile the engine if the .so is missing or older than the source."""
+    """Compile the engine if the .so is missing or built from different source.
+
+    Staleness is decided by a content hash of emul_engine.cpp stored next to
+    the .so — mtimes are arbitrary after a fresh checkout, so an mtime gate
+    could silently load a stale or foreign binary."""
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    if (not os.path.exists(_SO)
-            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+    stamp = _SO + ".srchash"
+    digest = _src_digest()
+    built = (os.path.exists(_SO) and os.path.exists(stamp)
+             and open(stamp).read().strip() == digest)
+    if not built:
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                "-o", _SO, _SRC]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"native engine build failed:\n{proc.stderr}")
+        with open(stamp, "w") as fh:
+            fh.write(digest)
     return _SO
 
 
@@ -111,7 +126,7 @@ def run_emul_native(params: Params, log: Optional[EventLog] = None,
         fail_time=plan.fail_time if plan.fail_time is not None else -1,
         drop_start=plan.drop_start if plan.drop_start is not None else -1,
         drop_stop=plan.drop_stop if plan.drop_stop is not None else -1,
-        drop_pct=int(params.MSG_DROP_PROB * 100) if params.DROP_MSG else 0,
+        drop_pct=params.drop_pct(),
         en_buffsize=params.EN_BUFFSIZE, max_msg_size=params.MAX_MSG_SIZE,
         join_mode=1 if params.JOIN_MODE == "batch" else 0,
         step_rate=params.STEP_RATE, seed=seed & (2**64 - 1),

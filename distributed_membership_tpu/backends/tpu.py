@@ -286,7 +286,7 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     total = total_time if total_time is not None else params.TOTAL_TIME
     cfg = StepConfig(
         n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
-        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        drop_prob=params.effective_drop_prob(),
         collect_events=collect_events)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,

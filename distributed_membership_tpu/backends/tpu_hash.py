@@ -168,7 +168,9 @@ def init_state_warm(cfg: HashConfig, key: jax.Array) -> HashState:
     view = _scatter_msgs(
         cfg, st.view, jnp.broadcast_to(idx[:, None], nbrs.shape), nbrs,
         jnp.zeros_like(nbrs), jnp.ones(nbrs.shape, bool))
-    view = view.at[idx, slot_of(cfg, idx, idx)].max(
+    # The self slot belongs to self unconditionally (admit() reserves it);
+    # overwrite any neighbor that collided into it during the warm scatter.
+    view = view.at[idx, slot_of(cfg, idx, idx)].set(
         pack(cfg, jnp.zeros((n,), I32), idx))
     return st._replace(
         view=view,
@@ -184,6 +186,8 @@ def make_step(cfg: HashConfig):
     intro = INTRODUCER_INDEX
     idx = jnp.arange(n, dtype=I32)
     k_max = min(cfg.fanout, s)
+    self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
+        cfg, idx, idx)[:, None]                                   # [N, S]
 
     def step(state: HashState, inputs):
         t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
@@ -209,7 +213,13 @@ def make_step(cfg: HashConfig):
             in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
             occupied = view > 0
             matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-            take = (incoming > 0) & (~occupied | matches)
+            # The self slot is occupied-by-self from the start: it admits only
+            # the node's own id even while empty, so no foreign id is ever
+            # evicted by the self refresh — preserving the sticky-admission
+            # invariant (module docstring: the only eviction is TREMOVE).
+            ok = jnp.where(self_slot_mask, in_id == idx[:, None],
+                           ~occupied | matches)
+            take = (incoming > 0) & ok
             return jnp.where(take, jnp.maximum(view, incoming), view)
 
         view = jnp.where(rcol, admit(state.view, state.amail), state.view)
@@ -399,13 +409,12 @@ def make_config(params: Params, collect_events: bool = True) -> HashConfig:
     n = params.EN_GPSZ
     s = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
     g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else s
-    params.validate_sparse_packing()
     qp = n if n <= 1024 else max(16, 8 * params.PROBES)
     seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,
-        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        drop_prob=params.effective_drop_prob(),
         probes=params.PROBES, qp=qp, seed_cap=seed_cap,
         collect_events=collect_events)
 
@@ -439,6 +448,8 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     """Run the full simulation; returns (final_state, events)."""
     cfg = make_config(params, collect_events)
     total = total_time if total_time is not None else params.TOTAL_TIME
+    # Same effective-run-length packing guard as tpu_sparse.run_scan.
+    params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"
 
     (ticks, keys, start_ticks, fail_mask, fail_time,

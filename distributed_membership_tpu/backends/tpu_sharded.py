@@ -273,7 +273,7 @@ def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
     total = total_time if total_time is not None else params.TOTAL_TIME
     cfg = StepConfig(
         n=n, tfail=params.TFAIL, tremove=params.TREMOVE, fanout=params.FANOUT,
-        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0)
+        drop_prob=params.effective_drop_prob())
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)

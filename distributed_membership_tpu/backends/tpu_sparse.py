@@ -248,6 +248,12 @@ def make_step(cfg: SparseConfig):
             apply_row=recv_mask | boot_row)
         slot_id, slot_hb, slot_ts = merged.slot_id, merged.slot_hb, merged.slot_ts
         join_ids = jnp.where(merged.join_mask, slot_id, EMPTY)
+        # The introducer's boot self-insert is silent in the reference
+        # (updateMyPos, MP1Node.cpp:308-322) and in the emul/dense backends;
+        # suppress it so dbg.log inventories match.  Joiner self-joins are
+        # unaffected: they coincide with the gossiped copy's arrival tick.
+        join_ids = jnp.where(boot_row[:, None] & (join_ids == idx[:, None]),
+                             EMPTY, join_ids)
 
         # ---- TFAIL / TREMOVE sweep (MP1Node.cpp:429-446) ----
         present = slot_id != EMPTY
@@ -391,7 +397,6 @@ def make_config(params: Params, collect_events: bool = True) -> SparseConfig:
     g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else m
     q = (params.MAILBOX_SIZE if params.MAILBOX_SIZE > 0
          else auto_mailbox_size(n, m, g, params.FANOUT))
-    params.validate_sparse_packing()
     # Probe in-degree is ~PROBES in expectation (each of the ~M holders of my
     # entry pings each view slot at rate PROBES/M); ack in-degree is exactly
     # the probes I sent.  Lossless (== N) while affordable, else 8x headroom
@@ -405,7 +410,7 @@ def make_config(params: Params, collect_events: bool = True) -> SparseConfig:
     return SparseConfig(
         n=n, m=m, q=q, g=min(g, m), tfail=params.TFAIL,
         tremove=params.TREMOVE, fanout=params.FANOUT,
-        drop_prob=(int(params.MSG_DROP_PROB * 100) / 100.0) if params.DROP_MSG else 0.0,
+        drop_prob=params.effective_drop_prob(),
         probes=params.PROBES, qp=qp, qa=qa, seed_cap=seed_cap,
         collect_events=collect_events)
 
@@ -448,6 +453,10 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     cfg = make_config(params, collect_events)
     n = cfg.n
     total = total_time if total_time is not None else params.TOTAL_TIME
+    # Re-validate against the *effective* run length: total_time may exceed
+    # params.TOTAL_TIME (bench/sweep drivers), which would otherwise bypass
+    # the uint32 (heartbeat, id) packing guard.
+    params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"
 
     (ticks, keys, start_ticks, fail_mask, fail_time,

@@ -166,16 +166,36 @@ class Params:
         if 2 * self.TOTAL_TIME >= 2**31:
             raise ValueError("TOTAL_TIME too large for int32 heartbeats")
 
-    def validate_sparse_packing(self) -> None:
+    def drop_pct(self) -> int:
+        """Integer drop percentage, quantized once.
+
+        The reference compares an integer percentage (``rand() % 100 <
+        (int)(MSG_DROP_PROB * 100)``, EmulNet.cpp:92), so all backends must
+        quantize identically — and exactly once: re-deriving the int from the
+        float ratio loses a point for some values (int(0.57*100/100*100)=56).
+        """
+        return int(self.MSG_DROP_PROB * 100) if self.DROP_MSG else 0
+
+    def effective_drop_prob(self) -> float:
+        """The quantized drop probability as a float (see :meth:`drop_pct`)."""
+        return self.drop_pct() / 100.0
+
+    def validate_sparse_packing(self, total_time: int | None = None) -> None:
         """The sparse backend's mailbox packs (heartbeat, id) into uint32 as
         ``hb * N + id + 1`` (ops/view_merge.scatter_mailbox); heartbeats reach
-        2*TOTAL_TIME + 2.  Reject configs where that overflows."""
-        max_packed = (2 * self.TOTAL_TIME + 2) * self.EN_GPSZ + self.EN_GPSZ
+        2*total_time + 2.  Reject configs where that overflows.
+
+        ``total_time`` is the *effective* run length — callers that extend the
+        run past TOTAL_TIME (bench/sweep drivers pass ``total_time=`` to
+        run_scan) must validate against the extended value, or the overflow
+        guard is silently bypassed."""
+        total = self.TOTAL_TIME if total_time is None else total_time
+        max_packed = (2 * total + 2) * self.EN_GPSZ + self.EN_GPSZ
         if max_packed >= 2**32:
             raise ValueError(
-                f"MAX_NNB={self.EN_GPSZ} x TOTAL_TIME={self.TOTAL_TIME} "
+                f"MAX_NNB={self.EN_GPSZ} x total_time={total} "
                 "overflows the sparse backend's uint32 (heartbeat, id) "
-                "packing; reduce TOTAL_TIME or node count")
+                "packing; reduce the run length or node count")
 
     # ------------------------------------------------------------------
     def start_tick(self, i: int) -> int:
