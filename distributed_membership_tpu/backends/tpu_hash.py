@@ -65,11 +65,13 @@ import numpy as np
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_sparse import (
-    SEED_CAP, SparseTickEvents, events_to_log)
+    SEED_CAP, SparseTickEvents, events_to_log, finish_run)
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.observability.aggregates import (
+    AggStats, init_agg, update_agg)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
-from distributed_membership_tpu.ops.view_merge import EMPTY
+from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.runtime.failures import (
     FailurePlan, log_failures, make_plan, plan_tensors)
 
@@ -92,6 +94,8 @@ class HashState(NamedTuple):
     joinreq_infl: jax.Array  # [N] bool
     joinrep_infl: jax.Array  # [N] bool
     pending_recv: jax.Array  # [N] i32
+    agg: AggStats        # on-device event aggregates (updated only when
+    #                      collect_events=False — the scale path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +158,7 @@ def init_state(cfg: HashConfig) -> HashState:
         joinreq_infl=jnp.zeros((n,), bool),
         joinrep_infl=jnp.zeros((n,), bool),
         pending_recv=jnp.zeros((n,), I32),
+        agg=init_agg(n),
     )
 
 
@@ -369,10 +374,19 @@ def make_step(cfg: HashConfig):
             # Probe: prober id into target's probe mailbox (salted hash) +
             # prober's own entry piggybacked into the gossip mailbox.
             qp = cfg.qp
-            paddr = p_tgt * qp + jax.lax.rem(own_id_p + t, qp)
-            paddr = jnp.where(p_valid, paddr, n * qp).reshape(-1)
             pval = jnp.where(p_valid, own_id_p.astype(U32) + U32(1), 0).reshape(-1)
-            pmail = pmail.reshape(-1).at[paddr].max(pval, mode="drop").reshape(n, qp)
+            # Redundant probe transmission when the slot map is lossy
+            # (qp < N): each probe is sent twice to independently-hashed
+            # slots, squaring the per-cycle loss (~3% → ~1e-3), so a
+            # TREMOVE-spanning run of consecutive misses is negligible even
+            # over 1M nodes x 700 ticks.  Injective maps need one copy.
+            p_copies = 1 if qp >= n else 2
+            for c in range(p_copies):
+                paddr = p_tgt * qp + hash_slot(own_id_p, t + c * 0x2545F49,
+                                               qp, n)
+                paddr = jnp.where(p_valid, paddr, n * qp).reshape(-1)
+                pmail = pmail.reshape(-1).at[paddr].max(
+                    pval, mode="drop").reshape(n, qp)
             mail = _scatter_msgs(cfg, mail, p_tgt, own_id_p, own_hb_p, p_valid)
             # Ack: my (id, current hb) into each prober's ack channel — lands
             # at the prober's slot for me, the exact entry the probe
@@ -380,10 +394,11 @@ def make_step(cfg: HashConfig):
             amail = _scatter_msgs(
                 cfg, amail, ack_tgt, jnp.broadcast_to(idx[:, None], ack_tgt.shape),
                 jnp.broadcast_to(own_hb[:, None], ack_tgt.shape), ack_ok)
-            sent_tick = (sent_tick + p_valid.sum(1, dtype=I32)
+            sent_tick = (sent_tick + p_valid.sum(1, dtype=I32) * p_copies
                          + ack_ok.sum(1, dtype=I32))
             recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
-                jnp.where(p_valid, p_tgt, n).reshape(-1)].add(1, mode="drop")[:n]
+                jnp.where(p_valid, p_tgt, n).reshape(-1)].add(
+                    p_copies, mode="drop")[:n]
             recv_add = recv_add + jnp.zeros((n + 1,), I32).at[
                 jnp.where(ack_ok, ack_tgt, n).reshape(-1)].add(1, mode="drop")[:n]
 
@@ -391,15 +406,24 @@ def make_step(cfg: HashConfig):
 
         failed = state.failed | (fail_mask & (t == fail_time))
 
-        new_state = HashState(view, view_ts, started, in_group, failed,
-                              self_hb, mail, amail, pmail, joinreq_infl,
-                              joinrep_infl, pending_recv)
         if cfg.collect_events:
+            agg = state.agg
             out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
         else:
+            # Scale path: fold events into O(N) on-device aggregates; emit
+            # only per-tick scalars so stacked outputs stay O(T).
+            agg = update_agg(
+                state.agg, t=t, join_ids=join_ids, rm_ids=rm_ids,
+                view_ids=cur_id, view_present=present,
+                fail_mask=fail_mask, fail_time=fail_time,
+                sent_tick=sent_tick, recv_tick=recv_tick)
             out = SparseTickEvents((join_ids != EMPTY).sum(dtype=I32),
                                    (rm_ids != EMPTY).sum(dtype=I32),
-                                   sent_tick, recv_tick)
+                                   sent_tick.sum(dtype=I32),
+                                   recv_tick.sum(dtype=I32))
+        new_state = HashState(view, view_ts, started, in_group, failed,
+                              self_hb, mail, amail, pmail, joinreq_infl,
+                              joinrep_infl, pending_recv, agg)
         return new_state, out
 
     return step
@@ -409,7 +433,12 @@ def make_config(params: Params, collect_events: bool = True) -> HashConfig:
     n = params.EN_GPSZ
     s = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
     g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else s
-    qp = n if n <= 1024 else max(16, 8 * params.PROBES)
+    # Probe in-degree is ~2*PROBES transmissions in expectation (redundant
+    # double-hash sends); 32x headroom keeps per-copy collision loss ~3%,
+    # squared to ~1e-3 per cycle by the redundancy, so a TREMOVE-spanning
+    # (>= 4-cycle, enforced by Params.validate) run of consecutive misses
+    # is ~1e-12 per entry — zero expected even at 1M x 700.
+    qp = n if n <= 1024 else max(128, 32 * params.PROBES)
     seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
@@ -470,14 +499,4 @@ def run_tpu_hash(params: Params, log: Optional[EventLog] = None,
     log = log if log is not None else EventLog()
     plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
 
-    final_state, events = run_scan(params, plan, seed)
-    events_to_log(params, plan, events, log)
-
-    return RunResult(
-        params=params, log=log,
-        sent=np.asarray(events.sent).T, recv=np.asarray(events.recv).T,
-        failed_indices=plan.failed_indices if plan.fail_time is not None else [],
-        fail_time=plan.fail_time,
-        wall_seconds=_time.time() - t0,
-        extra={"final_state": final_state},
-    )
+    return finish_run(params, plan, log, run_scan, t0, seed)

@@ -91,6 +91,12 @@ class Params:
     # whole racks of RACK_SIZE contiguous nodes at FAIL_TIME.
     RACK_SIZE: int = 0
     RACK_FAILURES: int = 0
+    # Event extraction mode on the bounded-view backends: 'full' stacks
+    # per-tick event tensors and reconstructs dbg.log exactly (grader
+    # parity; O(T*N*M) memory — ~350 GB at N=1M), 'agg' folds events into
+    # O(N) on-device aggregates and reports a detection summary instead
+    # (observability/aggregates.py), 'auto' picks by cluster size.
+    EVENT_MODE: str = "auto"
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -149,6 +155,9 @@ class Params:
             )
         if self.EN_GPSZ < 1:
             raise ValueError("MAX_NNB must be >= 1")
+        if self.EVENT_MODE not in ("auto", "full", "agg"):
+            raise ValueError(
+                f"EVENT_MODE must be auto|full|agg, got {self.EVENT_MODE!r}")
         if self.JOIN_MODE not in ("staggered", "batch", "warm"):
             raise ValueError(
                 f"JOIN_MODE must be staggered|batch|warm, got {self.JOIN_MODE!r}")
@@ -165,6 +174,22 @@ class Params:
         # silently overflowing (SURVEY.md hard-part #5).
         if 2 * self.TOTAL_TIME >= 2**31:
             raise ValueError("TOTAL_TIME too large for int32 heartbeats")
+        # SWIM protocol period: with bounded views, an entry is refreshed
+        # once per probe cycle of ceil(VIEW_SIZE/PROBES) ticks, so
+        # TFAIL/TREMOVE are meaningful only in units of that cycle.  A
+        # TREMOVE spanning < 4 cycles leaves so few refresh chances that
+        # ordinary percent-level message loss produces false removals in
+        # bulk (measured: ~9k per 65k-node run at 2 cycles).  Reject the
+        # misconfiguration instead of silently failing accuracy.
+        if (self.PROBES > 0 and self.VIEW_SIZE > 0
+                and self.BACKEND in ("tpu_sparse", "tpu_hash")):
+            cycle = -(-self.VIEW_SIZE // self.PROBES)
+            if self.TREMOVE < 4 * cycle:
+                raise ValueError(
+                    f"TREMOVE={self.TREMOVE} spans under 4 probe cycles "
+                    f"(cycle = ceil(VIEW_SIZE/PROBES) = {cycle} ticks): "
+                    "too few refresh chances per removal window; raise "
+                    "TREMOVE or PROBES")
 
     def drop_pct(self) -> int:
         """Integer drop percentage, quantized once.
@@ -196,6 +221,13 @@ class Params:
                 f"MAX_NNB={self.EN_GPSZ} x total_time={total} "
                 "overflows the sparse backend's uint32 (heartbeat, id) "
                 "packing; reduce the run length or node count")
+
+    def resolved_event_mode(self) -> str:
+        """'full' or 'agg' (see EVENT_MODE).  The auto threshold is sized so
+        the stacked [T, N, M] event tensors stay well under a GB."""
+        if self.EVENT_MODE != "auto":
+            return self.EVENT_MODE
+        return "full" if self.EN_GPSZ <= 4096 else "agg"
 
     # ------------------------------------------------------------------
     def start_tick(self, i: int) -> int:

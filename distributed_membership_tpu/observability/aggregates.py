@@ -1,0 +1,201 @@
+"""On-device event aggregation for scale runs.
+
+The grader-parity paths stack per-tick event tensors (``[T, N, M]`` join /
+remove ids) and reconstruct dbg.log host-side — exact, but structurally
+impossible at scale: N=1M, M=128, T=700 is ~350 GB.  The reference has the
+same wall in miniature: its per-node×tick ``sent_msgs/recv_msgs[1001][3600]``
+matrices (EmulNet.h:83-84) only exist because N is small.
+
+This module is the scale replacement: a small set of ``[N]``-shaped (plus one
+fixed-width histogram) accumulators carried *inside* the jitted scan state and
+updated with one masked scatter-add per tick, so a 1M-node run produces the
+full detection-latency distribution, completeness and accuracy verdicts, and
+msgcount totals — everything the grading oracle measures — in O(N) memory,
+independent of T.
+
+Accumulators (all updated only on the aggregate path — the parity path's
+behavior and cost are untouched):
+  * ``rm_count[N]``   — removal events naming id i (all observers, all ticks);
+  * ``rm_first[N]``   — first tick any observer removed id i (INT32_MAX none);
+  * ``rm_last[N]``    — last such tick;
+  * ``join_count[N]`` — join events naming id i;
+  * ``trackers[N]``   — how many views held id i at the failure-injection
+    tick: the denominator for per-view detection completeness (a bounded
+    view tracks ~M members, so "all N-1 survivors detect" is replaced by
+    "every *tracker* detects" — the SWIM-scale completeness criterion);
+  * ``lat_hist[LAT_BINS]`` — histogram of (removal tick - fail_time) over
+    removal events naming *failed* ids: the detection-latency distribution
+    (BASELINE.md fidelity row) straight off the device;
+  * ``sent_total[N] / recv_total[N]`` — per-node message totals (msgcount.log
+    totals row, EmulNet.cpp:189-218, without the per-tick matrix).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+LAT_BINS = 512          # ticks-after-failure resolution; last bin is overflow
+_NO_TICK = np.iinfo(np.int32).max
+
+
+class AggStats(NamedTuple):
+    rm_count: jax.Array    # [N] i32 — ALL removal events naming id i
+    det_count: jax.Array   # [N] i32 — true detections only: removals of a
+    #                        crashed id strictly after the crash tick
+    rm_first: jax.Array    # [N] i32, INT32_MAX = never removed
+    rm_last: jax.Array     # [N] i32, -1 = never removed
+    join_count: jax.Array  # [N] i32
+    trackers: jax.Array    # [N] i32, views holding id i at fail_time
+    tracker_obs: jax.Array  # [N] bool — live node i held >=1 crashed id at
+    #                         the crash tick (distinct-observer denominator)
+    det_obs: jax.Array     # [N] bool — node i issued >=1 true detection
+    #                        (distinct-observer numerator; event counts alone
+    #                        can overcount via readmission churn)
+    lat_hist: jax.Array    # [LAT_BINS] i32
+    sent_total: jax.Array  # [N] i32
+    recv_total: jax.Array  # [N] i32
+
+
+def init_agg(n: int) -> AggStats:
+    return AggStats(
+        rm_count=jnp.zeros((n,), I32),
+        det_count=jnp.zeros((n,), I32),
+        rm_first=jnp.full((n,), _NO_TICK, I32),
+        rm_last=jnp.full((n,), -1, I32),
+        join_count=jnp.zeros((n,), I32),
+        trackers=jnp.zeros((n,), I32),
+        tracker_obs=jnp.zeros((n,), bool),
+        det_obs=jnp.zeros((n,), bool),
+        lat_hist=jnp.zeros((LAT_BINS,), I32),
+        sent_total=jnp.zeros((n,), I32),
+        recv_total=jnp.zeros((n,), I32),
+    )
+
+
+def update_agg(agg: AggStats, *, t: jax.Array,
+               join_ids: jax.Array, rm_ids: jax.Array,
+               view_ids: jax.Array, view_present: jax.Array,
+               fail_mask: jax.Array, fail_time: jax.Array,
+               sent_tick: jax.Array, recv_tick: jax.Array) -> AggStats:
+    """One tick's aggregate update (pure, jittable, O(N*M) scatter-adds).
+
+    ``join_ids`` / ``rm_ids``: ``[N, M]`` member ids (EMPTY/-1 = no event) —
+    the same per-slot event tensors the parity path would have stacked.
+    ``view_ids`` / ``view_present``: the post-merge view table, used once (at
+    ``t == fail_time``) to count trackers per id.
+    """
+    n = agg.rm_count.shape[0]
+
+    def count_by_id(ids, mask):
+        sel = jnp.where(mask, ids, n)
+        return jnp.zeros((n + 1,), I32).at[sel.reshape(-1)].add(
+            1, mode="drop")[:n]
+
+    rm_mask = rm_ids >= 0
+    rm_add = count_by_id(rm_ids, rm_mask)
+    removed_any = rm_add > 0
+    rm_count = agg.rm_count + rm_add
+    rm_first = jnp.where(removed_any, jnp.minimum(agg.rm_first, t),
+                         agg.rm_first)
+    rm_last = jnp.where(removed_any, jnp.maximum(agg.rm_last, t), agg.rm_last)
+
+    join_count = agg.join_count + count_by_id(join_ids, join_ids >= 0)
+
+    # Tracker census, captured exactly once (the failure-injection tick) —
+    # lax.cond so the O(N*M) scatter runs on that one tick, not all T.
+    # Rows belonging to nodes that crash are excluded: a dead holder (and
+    # its self entry) can never detect, so it is not a completeness
+    # denominator.
+    at_fail = t == fail_time
+    live_holder = ~fail_mask[:, None]
+    holds_failed = view_present & fail_mask[jnp.clip(view_ids, 0)]
+    trackers, tracker_obs = jax.lax.cond(
+        at_fail,
+        lambda: (count_by_id(view_ids, view_present & live_holder),
+                 holds_failed.any(axis=1) & ~fail_mask),
+        lambda: (agg.trackers, agg.tracker_obs))
+
+    # True detections: removals naming a crashed id strictly after the
+    # crash.  A removal of that id *before* the crash is a false positive
+    # and must count as one — not as a detection with clipped latency.
+    true_rm = rm_mask & fail_mask[jnp.clip(rm_ids, 0)] & (t > fail_time)
+    det_count = agg.det_count + count_by_id(rm_ids, true_rm)
+    det_obs = agg.det_obs | true_rm.any(axis=1)
+
+    # Latency histogram: all true detections this tick share latency
+    # (t - fail_time); clip into the overflow bin (reported explicitly by
+    # detection_summary).
+    lat = jnp.clip(t - fail_time, 0, LAT_BINS - 1)
+    lat_hist = agg.lat_hist.at[lat].add(true_rm.sum(dtype=I32))
+
+    return AggStats(rm_count, det_count, rm_first, rm_last, join_count,
+                    trackers, tracker_obs, det_obs, lat_hist,
+                    agg.sent_total + sent_tick, agg.recv_total + recv_tick)
+
+
+def detection_summary(agg: AggStats, fail_mask: np.ndarray,
+                      fail_time: int | None) -> dict:
+    """Host-side verdicts from the aggregates: the grading oracle's
+    completeness/accuracy criteria (Grader_verbose.sh semantics) recast for
+    tracker-relative bounded views, plus the latency distribution."""
+    agg = jax.tree.map(np.asarray, agg)
+    fail_mask = np.asarray(fail_mask, bool)
+    n = agg.rm_count.shape[0]
+
+    # Accuracy: every removal that is not a true detection is false —
+    # including removals of a to-be-crashed id before its crash.
+    false_removals = int(agg.rm_count.sum() - agg.det_count.sum())
+    out = {
+        "n": n,
+        "joins_total": int(agg.join_count.sum()),
+        "false_removals": false_removals,          # accuracy: must be 0
+        "msgs_sent": int(agg.sent_total.sum()),
+        "msgs_recv": int(agg.recv_total.sum()),
+    }
+    if fail_time is not None and fail_mask.any():
+        failed = np.nonzero(fail_mask)[0]
+        trackers = agg.trackers[failed]
+        detections = agg.det_count[failed]
+        hist = agg.lat_hist
+        total_det = int(hist.sum())
+        tracker_nodes = int(agg.tracker_obs.sum())
+        detecting_trackers = int((agg.det_obs & agg.tracker_obs).sum())
+        out.update({
+            "failed_nodes": int(fail_mask.sum()),
+            "trackers_per_failed_min": int(trackers.min()),
+            "trackers_per_failed_mean": float(trackers.mean()),
+            "detections_total": total_det,
+            # Distinct-observer completeness: of the live nodes that held a
+            # crashed id at the crash, how many issued >= 1 true detection.
+            # (Event-count ratios can overcount via post-crash readmission
+            # churn; this is the honest grader-style criterion.)
+            "tracker_nodes": tracker_nodes,
+            "observer_completeness": (
+                detecting_trackers / tracker_nodes if tracker_nodes else 1.0),
+            # Event-count view, per failed id (>=1 event per tracker view).
+            "detection_completeness": (
+                float((detections >= trackers).mean())),
+            "detected_by_someone": float((detections > 0).mean()),
+        })
+        if total_det:
+            ticks = np.arange(LAT_BINS)
+            cdf = np.cumsum(hist)
+            overflow = int(hist[LAT_BINS - 1])
+            out.update({
+                "latency_min": int(ticks[hist > 0][0]),
+                "latency_max": int(ticks[hist > 0][-1]),
+                "latency_p50": int(np.searchsorted(cdf, 0.50 * total_det)),
+                "latency_p99": int(np.searchsorted(cdf, 0.99 * total_det)),
+                # Detections at >= LAT_BINS-1 ticks land in the last bin;
+                # when nonzero, max/percentiles at 511 mean ">= 511".
+                "latency_overflow_count": overflow,
+                "latency_hist_nonzero": {
+                    int(k): int(v) for k, v in zip(ticks[hist > 0],
+                                                   hist[hist > 0])},
+            })
+    return out

@@ -28,7 +28,10 @@ def run_conf(conf_path: str, backend: str | None = None,
         params.validate()
     result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
     result.log.flush(out_dir)
-    write_msgcount(result, out_dir)
+    if not result.extra.get("aggregate"):
+        # Aggregate (scale) runs carry per-node totals only; the [N, T]
+        # msgcount matrix is exactly what cannot exist at 1M nodes.
+        write_msgcount(result, out_dir)
     return result
 
 
@@ -69,6 +72,8 @@ def main(argv=None) -> int:
         "msgs_sent": int(result.sent.sum()),
         "failed_indices": result.failed_indices,
     }
+    if "detection_summary" in result.extra:
+        summary["detection"] = result.extra["detection_summary"]
     if args.grade:
         g = SCENARIO_GRADERS[args.grade](result.log.dbg_text(),
                                          result.params.EN_GPSZ)
