@@ -13,7 +13,10 @@ Backends:
   * ``tpu_sharded`` — node axis sharded over a device mesh (shard_map);
   * ``tpu_sparse``  — exact bounded member views (sorted merge);
   * ``tpu_hash``    — hash-slotted bounded views, elementwise-max merge:
-    the high-throughput scale path.
+    the high-throughput scale path;
+  * ``tpu_hash_sharded`` — tpu_hash node-sharded over a device mesh with a
+    bucketed all_to_all message exchange: the flagship multi-chip path
+    (BASELINE.json config #4).
 """
 
 from __future__ import annotations
@@ -65,6 +68,7 @@ _MODULES = {
     "tpu_sharded": "distributed_membership_tpu.backends.tpu_sharded",
     "tpu_sparse": "distributed_membership_tpu.backends.tpu_sparse",
     "tpu_hash": "distributed_membership_tpu.backends.tpu_hash",
+    "tpu_hash_sharded": "distributed_membership_tpu.backends.tpu_hash_sharded",
 }
 
 

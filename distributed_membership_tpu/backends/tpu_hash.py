@@ -65,7 +65,7 @@ import numpy as np
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_sparse import (
-    SEED_CAP, SparseTickEvents, events_to_log, finish_run)
+    SEED_CAP, SparseTickEvents, finish_run)
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
@@ -73,7 +73,7 @@ from distributed_membership_tpu.observability.aggregates import (
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, log_failures, make_plan, plan_tensors)
+    FailurePlan, make_plan, plan_tensors)
 
 I32 = jnp.int32
 U32 = jnp.uint32

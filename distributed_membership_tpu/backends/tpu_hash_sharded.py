@@ -1,0 +1,609 @@
+"""`tpu_hash_sharded` backend: the hashed bounded-view scale path sharded
+over a device mesh — the rebuild's flagship (BASELINE.json config #4).
+
+Node rows are sharded over a 1-D :class:`jax.sharding.Mesh`: shard ``d``
+owns rows ``[d*L, (d+1)*L)`` of the `tpu_hash` state — views, mailboxes and
+per-node scalars — and the whole run's ``lax.scan`` executes inside one
+``shard_map`` call, so state never leaves the devices.
+
+**The cross-chip EmulNet.**  The reference's network is a global in-memory
+mailbox (EmulNet.h:35-72); `tpu_hash` turned it into hash-slotted
+per-receiver mailboxes combined by ``max``.  Across chips the delivery
+becomes a *bucketed all_to_all* — the sparse random-fanout exchange the
+north star prescribes, rather than a dense [N, S] partial per shard (which
+would ring-reduce half a GB per tick at N=1M):
+
+  1. every shard flattens its tick's outgoing traffic — gossip entries,
+     probe transmissions (both redundant copies), acks, join requests, the
+     introducer's seed bursts — into one message list of
+     ``(target, packed entry, channel)`` triples;
+  2. the list is sorted by ``(destination shard, channel priority)`` and
+     cut into fixed-capacity per-destination buckets (capacity overflow
+     drops messages exactly like EmulNet's bounded buffer, EmulNet.cpp:90;
+     the sort priority makes overflow eat gossip before probes/acks);
+  3. one ``jax.lax.all_to_all`` ships the buckets over ICI;
+  4. each shard scatter-maxes what it received into its local mailboxes —
+     the same slot maps as `tpu_hash`, so per-id semantics are unchanged.
+
+Per-tick ICI traffic is proportional to actual messages (~L*(K*G + 6P)
+u32 pairs per shard), not to state size.  Everything else — the admit/
+refresh combine, the TFAIL/TREMOVE sweep, target sampling, SWIM round-robin
+probing — is `tpu_hash`'s elementwise/TPU-friendly code applied to the
+local rows (see backends/tpu_hash.py for the protocol argument; reference
+semantics per MP1Node.cpp:404-495).
+
+Join handshake state (who has a JOINREQ/JOINREP in flight, whether the
+introducer can receive) is a handful of ``[N]``-bool ``all_gather``s per
+tick, as in `tpu_sharded` — at scale runs use ``JOIN_MODE: warm`` and this
+machinery is inert.
+
+RNG: per-shard streams via ``fold_in(key, shard)`` for gossip targets and
+entry subsets; the tick keys themselves are replicated inputs.  Parity with
+single-chip `tpu_hash` is therefore distributional (same protocol, same
+fanout distribution), verified by the grader scenarios and the removal-
+latency window tests (tests/test_hash_sharded.py).
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+import time as _time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_membership_tpu.addressing import INTRODUCER_INDEX
+from distributed_membership_tpu.backends import RunResult, register
+from distributed_membership_tpu.backends.tpu_hash import (
+    HashConfig, I32, U32, make_config, pack, slot_of, unpack)
+from distributed_membership_tpu.backends.tpu_sparse import (
+    SparseTickEvents, finish_run)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.observability.aggregates import (
+    AggStats, init_agg, update_agg)
+from distributed_membership_tpu.ops.sampling import sample_k_indices
+from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
+from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from distributed_membership_tpu.runtime.failures import (
+    FailurePlan, make_plan, plan_tensors)
+
+INTRO = INTRODUCER_INDEX
+
+# Message channels (3 bits packed next to the target id).  Priority order =
+# numeric order: bucket-capacity overflow drops the highest channel first,
+# so reliability-critical probe/ack traffic survives congestion ahead of
+# (redundant) gossip — EmulNet drops indiscriminately (EmulNet.cpp:90); we
+# can do better without changing per-message semantics.
+CH_ACK = 0
+CH_PROBE0 = 1
+CH_PROBE1 = 2
+CH_JOIN = 3     # JOINREQ: admitted into the introducer's gossip mailbox
+CH_GOSSIP = 4
+N_CH = 5
+
+
+class ShardedHashState(NamedTuple):
+    """Per-shard slice: matrices are [L, S]-shaped local rows, vectors [L]."""
+    view: jax.Array
+    view_ts: jax.Array
+    started: jax.Array
+    in_group: jax.Array
+    failed: jax.Array
+    self_hb: jax.Array
+    mail: jax.Array
+    amail: jax.Array
+    pmail: jax.Array     # [L, Qp]
+    joinreq_infl: jax.Array
+    joinrep_infl: jax.Array
+    pending_recv: jax.Array
+    agg: AggStats        # per-shard partials over GLOBAL ids ([N]-shaped);
+    #                      psum-reduced once after the scan
+
+
+def init_local_state(cfg: HashConfig, n_local: int) -> ShardedHashState:
+    s = cfg.s
+    return ShardedHashState(
+        agg=init_agg(cfg.n, n_local),
+        view=jnp.zeros((n_local, s), U32),
+        view_ts=jnp.zeros((n_local, s), I32),
+        started=jnp.zeros((n_local,), bool),
+        in_group=jnp.zeros((n_local,), bool),
+        failed=jnp.zeros((n_local,), bool),
+        self_hb=jnp.zeros((n_local,), I32),
+        mail=jnp.zeros((n_local, s), U32),
+        amail=jnp.zeros((n_local, s), U32),
+        pmail=jnp.zeros((n_local, cfg.qp), U32),
+        joinreq_infl=jnp.zeros((n_local,), bool),
+        joinrep_infl=jnp.zeros((n_local,), bool),
+        pending_recv=jnp.zeros((n_local,), I32),
+    )
+
+
+def init_local_state_warm(cfg: HashConfig, n_local: int,
+                          key: jax.Array) -> ShardedHashState:
+    """Warm bootstrap of the local rows (cf. tpu_hash.init_state_warm)."""
+    me = lax.axis_index(NODE_AXIS)
+    lrows = me * n_local + jnp.arange(n_local, dtype=I32)
+    st = init_local_state(cfg, n_local)
+    fill = max(cfg.s // 2, 1)
+    offs = jax.random.randint(jax.random.fold_in(key, me),
+                              (n_local, fill), 1, max(cfg.n, 2), dtype=I32)
+    nbrs = lax.rem(lrows[:, None] + offs, cfg.n)
+    # Local scatter of neighbor entries into each local row's hashed slots.
+    addr = (jnp.arange(n_local, dtype=I32)[:, None] * cfg.s
+            + slot_of(cfg, lrows[:, None], nbrs))
+    view = st.view.reshape(-1).at[addr.reshape(-1)].max(
+        pack(cfg, jnp.zeros_like(nbrs), nbrs).reshape(-1),
+        mode="drop").reshape(n_local, cfg.s)
+    # Self slot belongs to self unconditionally.
+    view = view.at[jnp.arange(n_local), slot_of(cfg, lrows, lrows)].set(
+        pack(cfg, jnp.zeros((n_local,), I32), lrows))
+    return st._replace(view=view,
+                       started=jnp.ones((n_local,), bool),
+                       in_group=jnp.ones((n_local,), bool))
+
+
+def bucket_capacity(cfg: HashConfig, n_local: int, n_shards: int) -> int:
+    """Static per-destination-shard bucket size.
+
+    Expected per-dest traffic is ~L*(K*G + 3P (2 probe copies + ~1 ack
+    in expectation... acks mirror delivered probes) + joins)/D; 2.5x
+    headroom absorbs Poisson fluctuation, the introducer's seed bursts,
+    and ack fan-in skew.  Overflow drops lowest-priority messages —
+    EmulNet's bounded-buffer behavior (EmulNet.h:12)."""
+    k = min(cfg.fanout, cfg.s)
+    per_sender = k * cfg.g + 6 * cfg.probes + 2
+    seed_total = cfg.seed_cap * cfg.s
+    expect = (n_local * per_sender + seed_total) / n_shards
+    cap = int(2.5 * expect) + 64
+    return min(cap, n_local * per_sender + seed_total)
+
+
+def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
+    n, s, g = cfg.n, cfg.s, cfg.g
+    k_max = min(cfg.fanout, s)
+    cap = bucket_capacity(cfg, n_local, n_shards)
+    l_idx = jnp.arange(n_local, dtype=I32)
+
+    def step(state: ShardedHashState, inputs):
+        t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = inputs
+        me = lax.axis_index(NODE_AXIS)
+        row0 = (me * n_local).astype(I32)
+        lrows = row0 + l_idx
+        fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
+        key_l = jax.random.fold_in(key, me)
+        k_targets, k_entries, k_drop, k_drop_p = jax.random.split(key_l, 4)
+        k_ctrl = jax.random.split(key, 1)[0]   # replicated draw
+        start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,), (n_local,))
+        self_slot = slot_of(cfg, lrows, lrows)
+        self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == self_slot[:, None]
+
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+        if cfg.drop_prob > 0.0:
+            ctrl_kept_g = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+                            & drop_active)
+        else:
+            ctrl_kept_g = jnp.ones((2, n), bool)
+
+        # ---- pass 1: receive = admit-or-refresh combine on local rows ----
+        recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        rcol = recv_mask[:, None]
+        prev_id, _, prev_present = unpack(cfg, state.view)
+
+        def admit(view, incoming):
+            in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
+            occupied = view > 0
+            matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+            ok = jnp.where(self_slot_mask, in_id == lrows[:, None],
+                           ~occupied | matches)
+            take = (incoming > 0) & ok
+            return jnp.where(take, jnp.maximum(view, incoming), view)
+
+        view = jnp.where(rcol, admit(state.view, state.amail), state.view)
+        view = jnp.where(rcol, admit(view, state.mail), view)
+        changed = view > state.view
+        view_ts = jnp.where(changed, t, state.view_ts)
+        mail = jnp.where(rcol, 0, state.mail)
+        amail = jnp.where(rcol, 0, state.amail)
+
+        cur_id, cur_hb, present = unpack(cfg, view)
+        join_mask = changed & ~prev_present
+        join_ids = jnp.where(join_mask, cur_id, EMPTY)
+
+        ack_valid = (state.pmail > 0) & rcol
+        ack_tgt = jnp.where(ack_valid, state.pmail.astype(I32) - 1, 0)
+        pmail = jnp.where(rcol, 0, state.pmail)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        in_group = state.in_group | (state.joinrep_infl & recv_mask)
+        joinrep_infl = state.joinrep_infl & ~recv_mask
+
+        # ---- join handshake over gathered [N] bools ----
+        started_g = lax.all_gather(state.started, NODE_AXIS, tiled=True)
+        failed_g = lax.all_gather(state.failed, NODE_AXIS, tiled=True)
+        joinreq_g = lax.all_gather(state.joinreq_infl, NODE_AXIS, tiled=True)
+        in_group_g = lax.all_gather(in_group, NODE_AXIS, tiled=True)
+        intro_recv = (started_g[INTRO] & (t > start_ticks_g[INTRO])
+                      & ~failed_g[INTRO])
+        seeds_g = joinreq_g & intro_recv
+        joinreq_infl = state.joinreq_infl & ~intro_recv
+        rep_ok_g = seeds_g & ctrl_kept_g[1]
+        rep_ok_l = lax.dynamic_slice(rep_ok_g, (row0,), (n_local,))
+        joinrep_infl = joinrep_infl | rep_ok_l
+        n_seeds = seeds_g.sum(dtype=I32)
+        is_intro_row = lrows == INTRO
+        sent_rep = jnp.where(is_intro_row & intro_recv,
+                             rep_ok_g.sum(dtype=I32), 0)
+        pending_recv = pending_recv + rep_ok_l.astype(I32)
+
+        # ---- nodeStart ----
+        start_now = t == start_ticks_l
+        started = state.started | start_now
+        boot = t == start_ticks_g[INTRO]
+        in_group = in_group | (is_intro_row & boot)
+
+        ctrl0_l = lax.dynamic_slice(ctrl_kept_g[0], (row0,), (n_local,))
+        joiner_req = start_now & (lrows != INTRO) & ctrl0_l
+        joinreq_infl = joinreq_infl | joiner_req
+        sent_req = joiner_req.astype(I32)
+
+        # ---- self refresh ----
+        act = started & (t > start_ticks_l) & ~state.failed & in_group
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_on = act | (is_intro_row & boot)
+        self_val = pack(cfg, jnp.where(act, own_hb, 0), lrows)
+        old_self = view[l_idx, self_slot]
+        view = view.at[l_idx, self_slot].set(
+            jnp.where(self_on, self_val, old_self))
+        view_ts = view_ts.at[l_idx, self_slot].set(
+            jnp.where(self_on, t, view_ts[l_idx, self_slot]))
+        cur_id, cur_hb, present = unpack(cfg, view)
+
+        # ---- TFAIL / TREMOVE sweep ----
+        difft = t - view_ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        rm_ids = jnp.where(removes, cur_id, EMPTY)
+        view = jnp.where(removes, 0, view)
+        present = present & ~removes
+
+        # ---- gossip selection ----
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = cur_id == lrows[:, None]
+        eligible = fresh & ~is_self_slot & act[:, None]
+        in_seed = seeds_g[jnp.clip(cur_id, 0)] & present
+        eligible = jnp.where(is_intro_row[:, None], eligible & ~in_seed,
+                             eligible)
+        seed_burst_on = boolean_any(is_intro_row & act)
+        n_seeds_row = jnp.where(is_intro_row & act, n_seeds, 0)
+        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        tgt_slot, tgt_valid = sample_k_indices(k_targets, eligible, k_extra,
+                                               k_max)
+        tgt = jnp.take_along_axis(cur_id, tgt_slot, axis=1)         # [L, K]
+
+        if g >= s:
+            e_ids, e_hbs, e_valid = cur_id, cur_hb, fresh
+        else:
+            scores = jnp.where(is_self_slot, -1.0,
+                               jax.random.uniform(k_entries, (n_local, s)))
+            scores = jnp.where(fresh, scores, 2.0)
+            _, e_idx = jax.lax.top_k(-scores, g)
+            e_valid = jnp.take_along_axis(fresh, e_idx, axis=1)
+            e_ids = jnp.take_along_axis(cur_id, e_idx, axis=1)
+            e_hbs = jnp.take_along_axis(cur_hb, e_idx, axis=1)
+        g_eff = e_ids.shape[1]
+
+        msg_valid = tgt_valid[:, :, None] & e_valid[:, None, :]     # [L,K,G']
+        if cfg.drop_prob > 0.0:
+            kd_f, kd_s = jax.random.split(k_drop)
+            dropped = jax.random.bernoulli(kd_f, cfg.drop_prob,
+                                           (n_local, k_max, g_eff))
+            msg_valid = msg_valid & ~(dropped & drop_active)
+        else:
+            kd_s = k_drop
+
+        # ---- probe schedule (round-robin window, compacted to [L, P]) ----
+        msgs = []   # (tgt, val, chan, valid) flattened pieces
+
+        def emit(tgts, vals, chan, valids):
+            msgs.append((tgts.reshape(-1), vals.reshape(-1),
+                         jnp.full((tgts.size,), chan, I32),
+                         valids.reshape(-1)))
+
+        emit(jnp.broadcast_to(tgt[:, :, None], (n_local, k_max, g_eff)),
+             pack(cfg, jnp.broadcast_to(e_hbs[:, None, :],
+                                        (n_local, k_max, g_eff)),
+                  jnp.broadcast_to(e_ids[:, None, :],
+                                   (n_local, k_max, g_eff))),
+             CH_GOSSIP, msg_valid)
+
+        emit(jnp.full((n_local,), INTRO, I32),
+             pack(cfg, jnp.zeros((n_local,), I32), lrows),
+             CH_JOIN, joiner_req)
+
+        # Introducer seed burst: full fresh view to each of this tick's
+        # seeded joiners.  Only the introducer's shard emits valid entries.
+        _, seed_idx = jax.lax.top_k(seeds_g.astype(I32), min(cfg.seed_cap, n))
+        seed_valid = seeds_g[seed_idx] & seed_burst_on
+        intro_here = (INTRO >= row0) & (INTRO < row0 + n_local)
+        intro_local = jnp.clip(INTRO - row0, 0, n_local - 1)
+        intro_fresh = fresh[intro_local]
+        intro_ids = cur_id[intro_local]
+        intro_hbs = cur_hb[intro_local]
+        burst_valid = (seed_valid[:, None] & intro_fresh[None, :]
+                       & intro_here)
+        if cfg.drop_prob > 0.0:
+            dropped = jax.random.bernoulli(kd_s, cfg.drop_prob,
+                                           burst_valid.shape)
+            burst_valid = burst_valid & ~(dropped & drop_active)
+        emit(jnp.broadcast_to(seed_idx[:, None], burst_valid.shape),
+             pack(cfg, jnp.broadcast_to(intro_hbs[None, :], burst_valid.shape),
+                  jnp.broadcast_to(intro_ids[None, :], burst_valid.shape)),
+             CH_GOSSIP, burst_valid)
+
+        n_probe_tx = 0
+        if cfg.probes > 0:
+            ptr = lax.rem(t * cfg.probes, s)
+            widx = lax.rem(ptr + jnp.arange(cfg.probes, dtype=I32), s)
+            p_tgt = cur_id[:, widx]                               # [L, P]
+            p_ok = (jnp.take_along_axis(
+                        present & ~is_self_slot,
+                        jnp.broadcast_to(widx[None, :], (n_local, cfg.probes)),
+                        axis=1)
+                    & act[:, None])
+            ack_ok = ack_valid & act[:, None]
+            if cfg.drop_prob > 0.0:
+                kd1, kd2 = jax.random.split(k_drop_p)
+                p_ok = p_ok & ~(jax.random.bernoulli(
+                    kd1, cfg.drop_prob, p_ok.shape) & drop_active)
+                ack_ok = ack_ok & ~(jax.random.bernoulli(
+                    kd2, cfg.drop_prob, ack_ok.shape) & drop_active)
+            own_entry = pack(cfg, jnp.broadcast_to(own_hb[:, None], p_tgt.shape),
+                             jnp.broadcast_to(lrows[:, None], p_tgt.shape))
+            # Redundant transmission when the pmail map is lossy
+            # (tpu_hash.make_step): each copy is a separate wire message.
+            p_copies = 1 if cfg.qp >= n else 2
+            n_probe_tx = p_copies
+            emit(p_tgt, own_entry, CH_PROBE0, p_ok)
+            if p_copies == 2:
+                emit(p_tgt, own_entry, CH_PROBE1, p_ok)
+            # Acks: my (id, current hb) to each prober — collision-free
+            # slot-addressed delivery at the receiver.
+            emit(ack_tgt,
+                 pack(cfg, jnp.broadcast_to(own_hb[:, None], ack_tgt.shape),
+                      jnp.broadcast_to(lrows[:, None], ack_tgt.shape)),
+                 CH_ACK, ack_ok)
+            sent_probe_ack = (p_ok.sum(1, dtype=I32) * p_copies
+                              + ack_ok.sum(1, dtype=I32))
+        else:
+            sent_probe_ack = jnp.zeros((n_local,), I32)
+
+        all_tgt = jnp.concatenate([m[0] for m in msgs])
+        all_val = jnp.concatenate([m[1] for m in msgs])
+        all_chan = jnp.concatenate([m[2] for m in msgs])
+        all_ok = jnp.concatenate([m[3] for m in msgs])
+
+        # ---- bucket by destination shard, ship, deliver ----
+        dest = all_tgt // n_local
+        sort_key = jnp.where(all_ok, dest * N_CH + all_chan,
+                             n_shards * N_CH)
+        # a-plane carries (tgt, chan) packed; b-plane the entry payload.
+        a_plane = (all_tgt.astype(U32) * U32(8) + all_chan.astype(U32))
+        a_plane = jnp.where(all_ok, a_plane, U32(0xFFFFFFFF))
+        sort_key, a_sorted, b_sorted = jax.lax.sort(
+            (sort_key, a_plane, jnp.where(all_ok, all_val, 0)), num_keys=1)
+        counts = jnp.zeros((n_shards + 1,), I32).at[
+            jnp.where(all_ok, dest, n_shards)].add(1, mode="drop")[:n_shards]
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), I32), jnp.cumsum(counts)[:-1]])
+        take = offsets[:, None] + jnp.arange(cap, dtype=I32)[None, :]
+        in_bucket = jnp.arange(cap, dtype=I32)[None, :] < counts[:, None]
+        take = jnp.clip(take, 0, all_tgt.size - 1)
+        send_a = jnp.where(in_bucket, a_sorted[take], U32(0xFFFFFFFF))
+        send_b = jnp.where(in_bucket, b_sorted[take], 0)
+        # Overflow accounting (counts > cap drops the tail = lowest-priority
+        # channels, thanks to the sort order).
+        recv_a = lax.all_to_all(send_a, NODE_AXIS, split_axis=0,
+                                concat_axis=0, tiled=True).reshape(
+                                    n_shards * cap)
+        recv_b = lax.all_to_all(send_b, NODE_AXIS, split_axis=0,
+                                concat_axis=0, tiled=True).reshape(
+                                    n_shards * cap)
+
+        r_ok = recv_a != U32(0xFFFFFFFF)
+        r_tgt = (recv_a // U32(8)).astype(I32)
+        r_chan = (recv_a % U32(8)).astype(I32)
+        r_row = jnp.clip(r_tgt - row0, 0, n_local - 1)
+        r_ok = r_ok & (r_tgt >= row0) & (r_tgt < row0 + n_local)
+        r_id = ((recv_b - U32(1)) % U32(n)).astype(I32)
+
+        def scatter_channel(buf, slot, val, mask):
+            addr = jnp.where(mask, r_row * buf.shape[1] + slot,
+                             n_local * buf.shape[1])
+            return buf.reshape(-1).at[addr].max(
+                jnp.where(mask, val, 0), mode="drop").reshape(buf.shape)
+
+        view_slot = slot_of(cfg, r_tgt, r_id)
+        is_gossip = r_ok & ((r_chan == CH_GOSSIP) | (r_chan == CH_JOIN)
+                            | (r_chan == CH_PROBE0) | (r_chan == CH_PROBE1))
+        mail = scatter_channel(mail, view_slot, recv_b, is_gossip)
+        amail = scatter_channel(amail, view_slot, recv_b,
+                                r_ok & (r_chan == CH_ACK))
+        if cfg.probes > 0:
+            for c, ch in enumerate([CH_PROBE0, CH_PROBE1][:n_probe_tx]):
+                pslot = hash_slot(r_id, t + c * 0x2545F49, cfg.qp, n)
+                pmail = scatter_channel(pmail, pslot,
+                                        r_id.astype(U32) + U32(1),
+                                        r_ok & (r_chan == ch))
+        # JOINREQ flag for the introducer (value also merged as gossip, as
+        # in tpu_hash: the joiner's entry is admitted into intro's view).
+        # The in-flight joinreq bool is tracked sender-side above.
+
+        recv_add = jnp.zeros((n_local + 1,), I32).at[
+            jnp.where(r_ok, r_row, n_local)].add(1, mode="drop")[:n_local]
+        pending_recv = pending_recv + recv_add
+
+        sent_tick = (msg_valid.sum((1, 2), dtype=I32) + sent_req + sent_rep
+                     + sent_probe_ack
+                     + jnp.where(is_intro_row,
+                                 burst_valid.sum(dtype=I32), 0))
+
+        failed = state.failed | (fail_mask_l & (t == fail_time))
+
+        if cfg.collect_events:
+            agg = state.agg
+            out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
+        else:
+            # Per-shard partials: id-indexed fields are [N] scatter targets
+            # (psum-reduced after the scan), observer-row fields are local
+            # [L] slices (all_gathered after the scan).
+            agg = update_agg(
+                state.agg, t=t, join_ids=join_ids, rm_ids=rm_ids,
+                view_ids=cur_id, view_present=present,
+                fail_mask=fail_mask_g, fail_time=fail_time,
+                sent_tick=sent_tick, recv_tick=recv_tick,
+                holder_failed=fail_mask_l)
+            out = SparseTickEvents(
+                lax.psum((join_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
+                lax.psum((rm_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
+                lax.psum(sent_tick.sum(dtype=I32), NODE_AXIS),
+                lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
+
+        new_state = ShardedHashState(
+            view, view_ts, started, in_group, failed, self_hb, mail, amail,
+            pmail, joinreq_infl, joinrep_infl, pending_recv, agg)
+        return new_state, out
+
+    return step
+
+
+def boolean_any(x: jax.Array) -> jax.Array:
+    return x.any()
+
+
+def reduce_agg(agg: AggStats) -> AggStats:
+    """Reduce per-shard agg partials to the replicated global AggStats:
+    psum for counts/histogram, pmin/pmax for first/last ticks, all_gather
+    for observer-row-indexed fields."""
+    return AggStats(
+        rm_count=lax.psum(agg.rm_count, NODE_AXIS),
+        det_count=lax.psum(agg.det_count, NODE_AXIS),
+        rm_first=lax.pmin(agg.rm_first, NODE_AXIS),
+        rm_last=lax.pmax(agg.rm_last, NODE_AXIS),
+        join_count=lax.psum(agg.join_count, NODE_AXIS),
+        trackers=lax.psum(agg.trackers, NODE_AXIS),
+        tracker_obs=lax.all_gather(agg.tracker_obs, NODE_AXIS, tiled=True),
+        det_obs=lax.all_gather(agg.det_obs, NODE_AXIS, tiled=True),
+        lat_hist=lax.psum(agg.lat_hist, NODE_AXIS),
+        sent_total=lax.all_gather(agg.sent_total, NODE_AXIS, tiled=True),
+        recv_total=lax.all_gather(agg.recv_total, NODE_AXIS, tiled=True),
+    )
+
+
+_RUNNER_CACHE: dict = {}
+
+
+def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
+    cache_key = (cfg, n_local, mesh, warm)
+    if cache_key not in _RUNNER_CACHE:
+        n_shards = mesh.shape[NODE_AXIS]
+        step = make_sharded_step(cfg, n_local, n_shards)
+
+        def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
+                      drop_lo, drop_hi, warm_key):
+            state0 = (init_local_state_warm(cfg, n_local, warm_key) if warm
+                      else init_local_state(cfg, n_local))
+
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask_g,
+                                    fail_time, drop_lo, drop_hi))
+
+            final_state, out = lax.scan(body, state0, (ticks, keys))
+            if not cfg.collect_events:
+                final_state = final_state._replace(
+                    agg=reduce_agg(final_state.agg))
+            return final_state, out
+
+        # The reduced (or untouched-zero) agg is replicated; everything
+        # else is node-sharded.
+        agg_spec = AggStats(*(P() for _ in AggStats._fields))
+        state_spec = ShardedHashState(
+            **{f: (agg_spec if f == "agg" else P(NODE_AXIS))
+               for f in ShardedHashState._fields})
+        if cfg.collect_events:
+            out_spec = SparseTickEvents(
+                join_ids=P(None, NODE_AXIS, None),
+                rm_ids=P(None, NODE_AXIS, None),
+                sent=P(None, NODE_AXIS), recv=P(None, NODE_AXIS))
+        else:
+            out_spec = SparseTickEvents(P(None), P(None), P(None), P(None))
+
+        sharded = shard_map(
+            whole_run, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(state_spec, out_spec),
+            check_vma=False,
+        )
+        _RUNNER_CACHE[cache_key] = jax.jit(sharded)
+    return _RUNNER_CACHE[cache_key]
+
+
+def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
+                     mesh: Mesh, collect_events: bool = True,
+                     total_time: Optional[int] = None):
+    n = params.EN_GPSZ
+    d = mesh.shape[NODE_AXIS]
+    if n % d != 0:
+        raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
+    n_local = n // d
+    cfg = make_config(params, collect_events)
+    total = total_time if total_time is not None else params.TOTAL_TIME
+    params.validate_sparse_packing(total)
+    warm = params.JOIN_MODE == "warm"
+
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
+
+    run = _get_runner(cfg, n_local, mesh, warm)
+    final_state, events = run(keys, ticks, start_ticks, fail_mask,
+                              fail_time, drop_lo, drop_hi,
+                              jax.random.PRNGKey(seed ^ 0x5EED))
+    return final_state, jax.tree.map(np.asarray, events)
+
+
+@register("tpu_hash_sharded")
+def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
+                         seed: Optional[int] = None,
+                         mesh: Optional[Mesh] = None) -> RunResult:
+    t0 = _time.time()
+    seed = params.SEED if seed is None else seed
+    log = log if log is not None else EventLog()
+    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+
+    if mesh is None:
+        n_dev = len(jax.devices())
+        d = max(x for x in range(1, n_dev + 1) if params.EN_GPSZ % x == 0)
+        mesh = make_mesh(d)
+
+    def run_scan_bound(params, plan, seed, collect_events=True,
+                       total_time=None):
+        return run_scan_sharded(params, plan, seed, mesh,
+                                collect_events=collect_events,
+                                total_time=total_time)
+
+    result = finish_run(params, plan, log, run_scan_bound, t0, seed)
+    result.extra["mesh_size"] = mesh.shape[NODE_AXIS]
+    return result

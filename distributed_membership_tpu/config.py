@@ -29,7 +29,7 @@ DEFAULT_EN_BUFFSIZE = 30000
 DEFAULT_PORTNUM = 8001  # Params.cpp:12 (unused for addressing: ENinit forces port 0)
 
 _KNOWN_BACKENDS = ("emul", "emul_native", "tpu", "tpu_sharded", "tpu_sparse",
-                   "tpu_hash")
+                   "tpu_hash", "tpu_hash_sharded")
 
 
 @dataclasses.dataclass
@@ -161,8 +161,8 @@ class Params:
         if self.JOIN_MODE not in ("staggered", "batch", "warm"):
             raise ValueError(
                 f"JOIN_MODE must be staggered|batch|warm, got {self.JOIN_MODE!r}")
-        if self.JOIN_MODE == "warm" and self.BACKEND not in ("tpu_sparse",
-                                                             "tpu_hash"):
+        if self.JOIN_MODE == "warm" and self.BACKEND not in (
+                "tpu_sparse", "tpu_hash", "tpu_hash_sharded"):
             # Warm bootstrap needs backend support (pre-seeded views); on the
             # introducer-join backends a -1 start tick would silently
             # simulate nothing.
@@ -182,7 +182,8 @@ class Params:
         # bulk (measured: ~9k per 65k-node run at 2 cycles).  Reject the
         # misconfiguration instead of silently failing accuracy.
         if (self.PROBES > 0 and self.VIEW_SIZE > 0
-                and self.BACKEND in ("tpu_sparse", "tpu_hash")):
+                and self.BACKEND in ("tpu_sparse", "tpu_hash",
+                                     "tpu_hash_sharded")):
             cycle = -(-self.VIEW_SIZE // self.PROBES)
             if self.TREMOVE < 4 * cycle:
                 raise ValueError(

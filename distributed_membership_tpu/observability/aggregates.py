@@ -61,7 +61,11 @@ class AggStats(NamedTuple):
     recv_total: jax.Array  # [N] i32
 
 
-def init_agg(n: int) -> AggStats:
+def init_agg(n: int, rows: int | None = None) -> AggStats:
+    """``rows`` (default N) sizes the observer-row-indexed fields — a
+    node-sharded backend passes its local row count and psum/gathers the
+    partials after its scan (backends/tpu_hash_sharded.py)."""
+    rows = n if rows is None else rows
     return AggStats(
         rm_count=jnp.zeros((n,), I32),
         det_count=jnp.zeros((n,), I32),
@@ -69,11 +73,11 @@ def init_agg(n: int) -> AggStats:
         rm_last=jnp.full((n,), -1, I32),
         join_count=jnp.zeros((n,), I32),
         trackers=jnp.zeros((n,), I32),
-        tracker_obs=jnp.zeros((n,), bool),
-        det_obs=jnp.zeros((n,), bool),
+        tracker_obs=jnp.zeros((rows,), bool),
+        det_obs=jnp.zeros((rows,), bool),
         lat_hist=jnp.zeros((LAT_BINS,), I32),
-        sent_total=jnp.zeros((n,), I32),
-        recv_total=jnp.zeros((n,), I32),
+        sent_total=jnp.zeros((rows,), I32),
+        recv_total=jnp.zeros((rows,), I32),
     )
 
 
@@ -81,15 +85,21 @@ def update_agg(agg: AggStats, *, t: jax.Array,
                join_ids: jax.Array, rm_ids: jax.Array,
                view_ids: jax.Array, view_present: jax.Array,
                fail_mask: jax.Array, fail_time: jax.Array,
-               sent_tick: jax.Array, recv_tick: jax.Array) -> AggStats:
-    """One tick's aggregate update (pure, jittable, O(N*M) scatter-adds).
+               sent_tick: jax.Array, recv_tick: jax.Array,
+               holder_failed: jax.Array | None = None) -> AggStats:
+    """One tick's aggregate update (pure, jittable, O(rows*M) scatter-adds).
 
-    ``join_ids`` / ``rm_ids``: ``[N, M]`` member ids (EMPTY/-1 = no event) —
+    ``join_ids`` / ``rm_ids``: ``[rows, M]`` member ids (EMPTY/-1 = none) —
     the same per-slot event tensors the parity path would have stacked.
     ``view_ids`` / ``view_present``: the post-merge view table, used once (at
-    ``t == fail_time``) to count trackers per id.
+    ``t == fail_time``) to count trackers per id.  ``fail_mask`` is indexed
+    by *global member id*; ``holder_failed`` (default: fail_mask) is the
+    observer-row-aligned crash mask — a sharded caller passes its local
+    slice.
     """
     n = agg.rm_count.shape[0]
+    if holder_failed is None:
+        holder_failed = fail_mask
 
     def count_by_id(ids, mask):
         sel = jnp.where(mask, ids, n)
@@ -112,12 +122,12 @@ def update_agg(agg: AggStats, *, t: jax.Array,
     # its self entry) can never detect, so it is not a completeness
     # denominator.
     at_fail = t == fail_time
-    live_holder = ~fail_mask[:, None]
+    live_holder = ~holder_failed[:, None]
     holds_failed = view_present & fail_mask[jnp.clip(view_ids, 0)]
     trackers, tracker_obs = jax.lax.cond(
         at_fail,
         lambda: (count_by_id(view_ids, view_present & live_holder),
-                 holds_failed.any(axis=1) & ~fail_mask),
+                 holds_failed.any(axis=1) & ~holder_failed),
         lambda: (agg.trackers, agg.tracker_obs))
 
     # True detections: removals naming a crashed id strictly after the

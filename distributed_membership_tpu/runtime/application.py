@@ -35,6 +35,18 @@ def run_conf(conf_path: str, backend: str | None = None,
     return result
 
 
+def params_backend_needs_jax(args) -> bool:
+    """True when the selected backend will touch jax (everything except the
+    pure-host emul paths, whose runs must not pay a probe subprocess)."""
+    backend = args.backend
+    if backend is None:
+        try:
+            backend = Params.from_file(args.conf).BACKEND
+        except Exception:
+            return True
+    return backend not in ("emul", "emul_native")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distributed_membership_tpu",
@@ -54,9 +66,13 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", help="print a JSON summary line")
     args = ap.parse_args(argv)
 
-    if args.platform:
-        import jax
-        jax.config.update("jax_platforms", args.platform)
+    if params_backend_needs_jax(args):
+        # An unreachable TPU relay makes the first jax backend init hang
+        # forever (not fail); resolve the platform up front with a
+        # subprocess probe + cpu fallback (runtime/platform.py).
+        from distributed_membership_tpu.runtime.platform import (
+            resolve_platform)
+        resolve_platform(pin=args.platform)
 
     result = run_conf(args.conf, backend=args.backend, seed=args.seed,
                       out_dir=args.out_dir)

@@ -1,0 +1,76 @@
+"""Robust JAX platform resolution.
+
+This image boots every interpreter with an ``axon`` PJRT plugin
+(sitecustomize on PYTHONPATH) that forces ``jax_platforms=axon,cpu`` and
+dials a TPU relay during backend initialization.  When the relay is down,
+the first ``jax.devices()`` — or any implicit backend init, e.g. the first
+``jnp`` op — HANGS indefinitely rather than failing (observed both rounds).
+
+Nothing in-process can time that out safely, so the probe runs in a
+throwaway subprocess with a wall-clock timeout; on failure the caller's
+process pins ``jax_platforms=cpu`` *via jax.config* (the env var alone is
+overridden by the plugin's registration) before its first backend init.
+
+Call :func:`resolve_platform` before any jax computation.  The result is
+cached in ``DM_RESOLVED_PLATFORM`` so child processes and repeated calls
+skip the probe.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE = "import jax; print(jax.devices()[0].platform)"
+_CACHE_VAR = "DM_RESOLVED_PLATFORM"
+
+
+def probe_platform(timeout: float = 90.0, retries: int = 2) -> str | None:
+    """What platform does a fresh interpreter's default jax init land on?
+
+    Returns the platform name, or None if init fails or hangs past
+    ``timeout`` (``retries`` attempts).
+    """
+    for _ in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                timeout=timeout, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    return None
+
+
+def resolve_platform(timeout: float = 90.0, retries: int = 2,
+                     pin: str | None = None) -> str:
+    """Ensure this process's jax will initialize, and say on what.
+
+    ``pin`` skips probing and pins that platform outright.  Otherwise:
+    probe in a subprocess; if the default init is unusable, pin cpu here
+    and return 'cpu'.  Must run before the first jax backend init in this
+    process.
+    """
+    import jax
+
+    if pin:
+        jax.config.update("jax_platforms", pin)
+        os.environ[_CACHE_VAR] = pin
+        return pin
+
+    cached = os.environ.get(_CACHE_VAR)
+    if cached:
+        if cached == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        return cached
+
+    platform = probe_platform(timeout=timeout, retries=retries)
+    if platform is None:
+        print("warning: default jax backend init failed or hung; "
+              "falling back to cpu", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    os.environ[_CACHE_VAR] = platform
+    return platform

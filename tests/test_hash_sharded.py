@@ -1,0 +1,101 @@
+"""`tpu_hash_sharded`: the flagship sharded scale backend.
+
+Three layers (mirroring the single-chip `tpu_hash` suite):
+  1. grader parity at N=10 across a 5-shard mesh — the protocol, join
+     handshake, and drop window all crossing shard boundaries through the
+     bucketed all_to_all exchange;
+  2. removal-latency distribution inside the reference's window;
+  3. the scale regime — warm bootstrap + SWIM probing on an 8-shard mesh
+     with on-device aggregation: full tracker-completeness, zero false
+     removals, and agreement with the single-chip backend's behavior.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import grade_scenario
+from distributed_membership_tpu.observability.metrics import removal_latencies
+from distributed_membership_tpu.runtime.failures import make_plan
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_scenario_passes_grader(testcases_dir, scenario):
+    params = Params.from_file(str(testcases_dir / f"{scenario}.conf"))
+    params.BACKEND = "tpu_hash_sharded"
+    result = get_backend("tpu_hash_sharded")(params, seed=3)
+    assert result.extra["mesh_size"] == 5   # largest divisor of 10 <= 8
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
+
+
+def test_removal_latency_in_reference_window(testcases_dir):
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = "tpu_hash_sharded"
+    lat = removal_latencies(
+        get_backend("tpu_hash_sharded")(params, seed=3).log.dbg_text(), 100)
+    assert len(lat) == 9
+    assert set(lat) <= {21, 22, 23}, lat
+
+
+def test_warm_scale_detection_on_mesh():
+    p = Params.from_text(
+        "MAX_NNB: 2048\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nFANOUT: 3\n"
+        "TOTAL_TIME: 150\nFAIL_TIME: 100\nJOIN_MODE: warm\n"
+        "EVENT_MODE: agg\nBACKEND: tpu_hash_sharded\n")
+    result = get_backend("tpu_hash_sharded")(p, seed=2)
+    assert result.extra["mesh_size"] == 8
+    s = result.extra["detection_summary"]
+    assert s["false_removals"] == 0
+    assert s["observer_completeness"] == 1.0
+    assert s["detection_completeness"] == 1.0
+    assert s["trackers_per_failed_min"] >= 1
+    assert s["latency_min"] >= p.TFAIL
+    assert s["latency_max"] <= p.TREMOVE + p.VIEW_SIZE // p.PROBES + 5
+    # Every live node still holds a full-ish view (gossip keeps flowing
+    # across shards).
+    final = result.extra["final_state"]
+    occ = (np.asarray(final.view) > 0).sum(1)
+    assert occ.min() >= p.VIEW_SIZE // 2
+
+
+def test_rack_failure_on_mesh():
+    p = Params.from_text(
+        "MAX_NNB: 1024\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nFANOUT: 3\n"
+        "TOTAL_TIME: 150\nFAIL_TIME: 100\nJOIN_MODE: warm\n"
+        "EVENT_MODE: agg\nRACK_SIZE: 32\nRACK_FAILURES: 2\n"
+        "BACKEND: tpu_hash_sharded\n")
+    plan = make_plan(p, random.Random("app:2"))
+    assert plan.kind == "racks" and len(plan.failed_indices) == 64
+    result = get_backend("tpu_hash_sharded")(p, seed=2)
+    s = result.extra["detection_summary"]
+    assert s["failed_nodes"] == 64
+    assert s["false_removals"] == 0
+    assert s["observer_completeness"] == 1.0
+    assert s["detected_by_someone"] == 1.0
+
+
+def test_mesh_matches_single_chip_distribution():
+    """Sharded and single-chip tpu_hash agree distributionally: same
+    config/seed list, detection latency medians within a couple of ticks."""
+    conf = ("MAX_NNB: 512\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+            "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nFANOUT: 3\n"
+            "TOTAL_TIME: 150\nFAIL_TIME: 100\nJOIN_MODE: warm\n"
+            "EVENT_MODE: agg\nBACKEND: {b}\n")
+
+    def p50s(backend):
+        out = []
+        for seed in (0, 1, 2):
+            p = Params.from_text(conf.format(b=backend))
+            r = get_backend(backend)(p, seed=seed)
+            out.append(r.extra["detection_summary"]["latency_p50"])
+        return out
+
+    sharded, single = p50s("tpu_hash_sharded"), p50s("tpu_hash")
+    assert abs(np.mean(sharded) - np.mean(single)) <= 3, (sharded, single)
