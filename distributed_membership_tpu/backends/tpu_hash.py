@@ -184,23 +184,33 @@ def init_state_warm(cfg: HashConfig, key: jax.Array) -> HashState:
     )
 
 
-def make_step(cfg: HashConfig):
+def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
     """Per-tick transition; same pass structure as the dense backend
-    (backends/tpu.py) with hashed coordinates.  Pure/jittable."""
+    (backends/tpu.py) with hashed coordinates.  Pure/jittable.
+
+    With ``dynamic_knobs`` the returned step takes two extra *traced*
+    scalars ``(fanout, drop_prob)`` after ``inputs`` — ``cfg.fanout`` then
+    only bounds the static target count and ``cfg.drop_prob`` is ignored.
+    This lets a phase-diagram sweep compile ONE step and vmap it over the
+    whole (fanout x drop-rate) grid instead of one compile per cell
+    (sweeps/phase.py)."""
     n, s, g = cfg.n, cfg.s, cfg.g
     intro = INTRODUCER_INDEX
     idx = jnp.arange(n, dtype=I32)
     k_max = min(cfg.fanout, s)
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
+    use_drop = dynamic_knobs or cfg.drop_prob > 0.0
 
-    def step(state: HashState, inputs):
+    def step(state: HashState, inputs, fanout=None, drop_prob=None):
         t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
         k_targets, k_entries, k_drop, k_ctrl, k_drop_p = jax.random.split(key, 5)
+        fanout_eff = cfg.fanout if fanout is None else fanout
+        p_drop = cfg.drop_prob if drop_prob is None else drop_prob
 
         drop_active = (t > drop_lo) & (t <= drop_hi)
-        if cfg.drop_prob > 0.0:
-            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, cfg.drop_prob, (2, n))
+        if use_drop:
+            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, p_drop, (2, n))
                           & drop_active)
         else:
             ctrl_kept = jnp.ones((2, n), bool)
@@ -304,7 +314,7 @@ def make_step(cfg: HashConfig):
         eligible = eligible.at[intro].set(eligible[intro] & ~in_seed[intro])
         seed_burst_on = act[intro]
         n_seeds_row = jnp.where((idx == intro) & seed_burst_on, n_seeds, 0)
-        k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
+        k_extra = jnp.clip(jnp.minimum(fanout_eff, numpotential) - n_seeds_row, 0)
         tgt_slot, tgt_valid = sample_k_indices(k_targets, eligible, k_extra, k_max)
         tgt = jnp.take_along_axis(cur_id, tgt_slot, axis=1)
 
@@ -321,9 +331,9 @@ def make_step(cfg: HashConfig):
         g_eff = e_ids.shape[1]
 
         msg_valid = tgt_valid[:, :, None] & e_valid[:, None, :]
-        if cfg.drop_prob > 0.0:
+        if use_drop:
             k_drop_f, k_drop_s = jax.random.split(k_drop)
-            dropped = jax.random.bernoulli(k_drop_f, cfg.drop_prob,
+            dropped = jax.random.bernoulli(k_drop_f, p_drop,
                                            (n, k_max, g_eff))
             msg_valid = msg_valid & ~(dropped & drop_active)
         else:
@@ -342,8 +352,8 @@ def make_step(cfg: HashConfig):
         _, seed_idx = jax.lax.top_k(seeds.astype(I32), min(cfg.seed_cap, n))
         seed_valid = seeds[seed_idx] & seed_burst_on
         burst_valid = seed_valid[:, None] & fresh[intro][None, :]
-        if cfg.drop_prob > 0.0:
-            dropped = jax.random.bernoulli(k_drop_s, cfg.drop_prob,
+        if use_drop:
+            dropped = jax.random.bernoulli(k_drop_s, p_drop,
                                            (seed_idx.shape[0], s))
             burst_valid = burst_valid & ~(dropped & drop_active)
         mail = _scatter_msgs(
@@ -363,12 +373,12 @@ def make_step(cfg: HashConfig):
             p_valid = sweep[None, :] & present & ~is_self_slot & act[:, None]
             p_tgt = jnp.where(p_valid, cur_id, EMPTY)
             ack_ok = ack_valid & act[:, None]
-            if cfg.drop_prob > 0.0:
+            if use_drop:
                 kd1, kd2 = jax.random.split(k_drop_p)
                 p_valid = p_valid & ~(jax.random.bernoulli(
-                    kd1, cfg.drop_prob, p_valid.shape) & drop_active)
+                    kd1, p_drop, p_valid.shape) & drop_active)
                 ack_ok = ack_ok & ~(jax.random.bernoulli(
-                    kd2, cfg.drop_prob, ack_ok.shape) & drop_active)
+                    kd2, p_drop, ack_ok.shape) & drop_active)
             own_id_p = jnp.broadcast_to(idx[:, None], p_tgt.shape)
             own_hb_p = jnp.broadcast_to(own_hb[:, None], p_tgt.shape)
             # Probe: prober id into target's probe mailbox (salted hash) +

@@ -19,11 +19,13 @@ Plus a handful of tiny ``[N]``-bool ``all_gather``s for the join handshake
 (the introducer needs the global JOINREQ view; everyone needs the
 introducer's liveness bit).
 
-RNG discipline: the target-sampling scores and control-drop coins are drawn
-*replicated* (same key on every shard) and row-sliced, so in drop-free runs
-this backend's trajectory is bit-identical to the dense backend's
-(tests/test_sharded.py); per-message gossip drops are decorrelated per shard
-(fold_in on the shard index) and match only distributionally.
+RNG discipline: by default the target-sampling scores are drawn *per shard*
+([L, N], keys folded by shard index), so per-tick per-shard FLOPs and
+memory scale as N^2/S.  The ``replicated_rng`` debug mode draws the full
+[N, N] replicated and row-slices, making drop-free trajectories
+bit-identical to the dense backend's and invariant to mesh size
+(tests/test_sharded.py) — the sharding-changes-nothing proof.  Per-message
+gossip drops are always shard-decorrelated and match distributionally.
 """
 
 from __future__ import annotations
@@ -56,12 +58,20 @@ from distributed_membership_tpu.runtime.failures import make_plan, plan_tensors
 INTRO = INTRODUCER_INDEX
 
 
-def make_sharded_step(cfg: StepConfig, n_local: int):
+def make_sharded_step(cfg: StepConfig, n_local: int,
+                      replicated_rng: bool = False):
     """Per-tick transition over shard-local state.
 
     Shapes inside shard_map: matrices ``[L, N]`` (this shard's rows of the
     global ``[N, N]``), per-node vectors ``[L]``.  ``row0`` is this shard's
     first global row index.
+
+    ``replicated_rng`` is the bit-parity debug mode: every shard draws the
+    full ``[N, N]`` score tensor with the same key and slices its rows, so
+    the trajectory is bit-identical to the dense backend (and invariant to
+    mesh size) — at the cost of O(N^2) per-shard work.  The default draws
+    per-shard ``[L, N]`` scores (same distribution, keys folded by shard),
+    so per-tick per-shard FLOPs and memory scale as N^2/S.
     """
     n = cfg.n
 
@@ -167,10 +177,15 @@ def make_sharded_step(cfg: StepConfig, n_local: int):
                              eligible)
         n_seeds_row = jnp.where(is_intro_row & act, n_seeds, 0)
         k_extra = jnp.clip(jnp.minimum(cfg.fanout, numpotential) - n_seeds_row, 0)
-        # Replicated [N, N] score draw sliced to local rows: selections match
-        # the dense backend bit-for-bit for the same seed.
-        scores_g = jax.random.uniform(k_targets, (n, n))
-        scores_l = lax.dynamic_slice(scores_g, (row0, 0), (n_local, n))
+        if replicated_rng:
+            # Bit-parity debug mode: replicated [N, N] draw sliced to local
+            # rows — selections match the dense backend bit-for-bit.
+            scores_g = jax.random.uniform(k_targets, (n, n))
+            scores_l = lax.dynamic_slice(scores_g, (row0, 0), (n_local, n))
+        else:
+            # Scalable default: per-shard [L, N] draw, same distribution.
+            scores_l = jax.random.uniform(
+                jax.random.fold_in(k_targets, me), (n_local, n))
         targets_idx, targets_valid = sample_k_indices(
             k_targets, eligible, k_extra, min(cfg.fanout, n), scores=scores_l)
 
@@ -226,14 +241,15 @@ def init_local_state(n: int, n_local: int) -> State:
 _RUNNER_CACHE: dict = {}
 
 
-def _get_runner(cfg: StepConfig, n_local: int, mesh: Mesh):
+def _get_runner(cfg: StepConfig, n_local: int, mesh: Mesh,
+                replicated_rng: bool = False):
     """One compiled shard_map scan per (config, mesh): per-run values are jit
     arguments so repeated seeds/scenarios never re-trace (same pattern as
     backends/tpu.py's _get_runner)."""
-    cache_key = (cfg, n_local, mesh)
+    cache_key = (cfg, n_local, mesh, replicated_rng)
     if cache_key not in _RUNNER_CACHE:
         n = cfg.n
-        step = make_sharded_step(cfg, n_local)
+        step = make_sharded_step(cfg, n_local, replicated_rng)
 
         def whole_run(keys, ticks, start_ticks, fail_mask_l, fail_time,
                       drop_lo, drop_hi):
@@ -263,7 +279,8 @@ def _get_runner(cfg: StepConfig, n_local: int, mesh: Mesh):
 
 
 def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
-                     total_time: Optional[int] = None):
+                     total_time: Optional[int] = None,
+                     replicated_rng: bool = False):
     """Jit + shard_map the full simulation over the mesh."""
     n = params.EN_GPSZ
     s = mesh.shape[NODE_AXIS]
@@ -278,7 +295,7 @@ def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
 
-    run = _get_runner(cfg, n_local, mesh)
+    run = _get_runner(cfg, n_local, mesh, replicated_rng)
     final_state, events = run(keys, ticks, start_ticks, fail_mask,
                               fail_time, drop_lo, drop_hi)
     return final_state, jax.tree.map(np.asarray, events)
@@ -287,7 +304,8 @@ def run_scan_sharded(params: Params, plan, seed: int, mesh: Mesh,
 @register("tpu_sharded")
 def run_tpu_sharded(params: Params, log: Optional[EventLog] = None,
                     seed: Optional[int] = None,
-                    mesh: Optional[Mesh] = None) -> RunResult:
+                    mesh: Optional[Mesh] = None,
+                    replicated_rng: bool = False) -> RunResult:
     t0 = _time.time()
     seed = params.SEED if seed is None else seed
     log = log if log is not None else EventLog()
@@ -299,7 +317,8 @@ def run_tpu_sharded(params: Params, log: Optional[EventLog] = None,
         s = max(d for d in range(1, n_dev + 1) if params.EN_GPSZ % d == 0)
         mesh = make_mesh(s)
 
-    final_state, events = run_scan_sharded(params, plan, seed, mesh)
+    final_state, events = run_scan_sharded(params, plan, seed, mesh,
+                                           replicated_rng=replicated_rng)
     events_to_log(params, plan, events, log)
 
     return RunResult(

@@ -25,9 +25,10 @@ def sample_k_distinct(key: jax.Array, eligible: jax.Array, k: jax.Array,
       k: ``[N]`` int — subset size per row (values beyond the number of
         eligible positions select all of them).
       scores: optional pre-drawn iid uniform ``[N, M]`` scores — used by the
-        sharded backend, which draws the full score tensor replicated and
-        slices its local rows so shard-local selections match the dense
-        backend's exactly.
+        sharded backend, which draws per-shard ``[L, N]`` scores by default
+        (and, in its ``replicated_rng`` bit-parity debug mode, the full
+        tensor replicated + row-sliced so selections match the dense
+        backend's exactly).
 
     Returns:
       ``[N, M]`` bool mask with ``min(k[i], eligible[i].sum())`` True

@@ -35,16 +35,78 @@ def run_conf(conf_path: str, backend: str | None = None,
     return result
 
 
-def params_backend_needs_jax(args) -> bool:
-    """True when the selected backend will touch jax (everything except the
+def grade_all(args) -> int:
+    """Run the three grading scenarios and print the /90 total — the
+    rebuild's equivalent of Grader_verbose.sh's build-run-score loop
+    (Grader_verbose.sh:27-196; 'make' is jit compilation here)."""
+    import os
+    import tempfile
+
+    testdir = args.testcases
+    if testdir is None:
+        testdir = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "testcases")
+
+    scenarios = ("singlefailure", "multifailure", "msgdropsinglefailure")
+    if args.backend is not None:
+        needs_jax = _backend_needs_jax(args.backend)
+    else:
+        needs_jax = any(
+            _backend_needs_jax(_conf_backend(
+                os.path.join(testdir, f"{s}.conf")))
+            for s in scenarios)
+    if needs_jax:
+        from distributed_membership_tpu.runtime.platform import (
+            resolve_platform)
+        resolve_platform(pin=args.platform)
+
+    total = 0
+    print("============================================")
+    print("Grading Started")
+    print("============================================")
+    for scenario, title in (("singlefailure", "Single Failure Scenario"),
+                            ("multifailure", "Multi Failure Scenario"),
+                            ("msgdropsinglefailure",
+                             "Message Drop Single Failure Scenario")):
+        print(title)
+        print("============================")
+        with tempfile.TemporaryDirectory() as tmp:
+            result = run_conf(os.path.join(testdir, f"{scenario}.conf"),
+                              backend=args.backend, seed=args.seed,
+                              out_dir=tmp)
+        g = SCENARIO_GRADERS[scenario](result.log.dbg_text(),
+                                       result.params.EN_GPSZ)
+        print(f"Checking Join.................."
+              f"{g.join_pts}/{g.join_max}")
+        print(f"Checking Completeness.........."
+              f"{g.completeness_pts}/{g.completeness_max}")
+        if g.accuracy_max:
+            print(f"Checking Accuracy.............."
+                  f"{g.accuracy_pts}/{g.accuracy_max}")
+        print("============================================")
+        total += g.points
+    print(f"Final grade {total}")
+    return 0 if total == 90 else 1
+
+
+def _backend_needs_jax(backend: str) -> bool:
+    """True when the backend will touch jax (everything except the
     pure-host emul paths, whose runs must not pay a probe subprocess)."""
+    return backend not in ("emul", "emul_native")
+
+
+def _conf_backend(conf_path: str) -> str:
+    try:
+        return Params.from_file(conf_path).BACKEND
+    except Exception:
+        return "tpu"   # unknown conf: assume jax so the probe still runs
+
+
+def params_backend_needs_jax(args) -> bool:
     backend = args.backend
     if backend is None:
-        try:
-            backend = Params.from_file(args.conf).BACKEND
-        except Exception:
-            return True
-    return backend not in ("emul", "emul_native")
+        backend = _conf_backend(args.conf)
+    return _backend_needs_jax(backend)
 
 
 def main(argv=None) -> int:
@@ -52,9 +114,19 @@ def main(argv=None) -> int:
         prog="python -m distributed_membership_tpu",
         description="TPU-native gossip membership simulator "
                     "(drop-in for the reference ./Application <conf>)")
-    ap.add_argument("conf", help="testcase .conf file (legacy 4-key format + extensions)")
+    ap.add_argument("conf", nargs="?", default=None,
+                    help="testcase .conf file (legacy 4-key format + "
+                         "extensions); omit with --grade-all")
     ap.add_argument("--backend", default=None,
-                    help="override BACKEND from the conf (emul|emul_native|tpu|tpu_sharded|tpu_sparse)")
+                    help="override BACKEND from the conf (emul|emul_native|"
+                         "tpu|tpu_sharded|tpu_sparse|tpu_hash|"
+                         "tpu_hash_sharded)")
+    ap.add_argument("--grade-all", action="store_true",
+                    help="run all three grading scenarios and print the /90 "
+                         "total (Grader_verbose.sh's build-run-score loop)")
+    ap.add_argument("--testcases", default=None,
+                    help="directory holding the three scenario .conf files "
+                         "(default: ./testcases next to the repo root)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--out-dir", default=".")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
@@ -65,6 +137,11 @@ def main(argv=None) -> int:
                     help="self-grade the run with the ported grading oracle")
     ap.add_argument("--json", action="store_true", help="print a JSON summary line")
     args = ap.parse_args(argv)
+
+    if args.grade_all:
+        return grade_all(args)
+    if args.conf is None:
+        ap.error("conf is required unless --grade-all is given")
 
     if params_backend_needs_jax(args):
         # An unreachable TPU relay makes the first jax backend init hang

@@ -1,0 +1,165 @@
+"""Phase-diagram sweep: fanout x drop-rate grid (BASELINE.json config #5).
+
+Maps the detection phase boundary of the gossip/SWIM protocol: for each
+(fanout, drop_rate) cell the sweep runs the `tpu_hash` scale protocol from a
+warm bootstrap, crashes one node, and records detection completeness,
+latency percentiles, false removals, and message volume.
+
+**One compile for the whole grid.**  The step is built with
+``dynamic_knobs=True`` (backends/tpu_hash.py): fanout and drop probability
+enter as *traced* scalars, so the full grid — every cell x every seed — runs
+as a single ``jax.vmap`` over one jitted scan.  A naive sweep would pay one
+XLA compile per cell (~56 compiles); this pays one.
+
+Drops here apply to the WHOLE run (the phase variable is the channel's loss
+rate), unlike the grading scenarios' [50, 300) window
+(Application.cpp:177-179).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.backends.tpu_hash import (
+    HashConfig, I32, init_state_warm, make_config, make_step)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.observability.aggregates import LAT_BINS
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepSpec:
+    n: int = 4096
+    view_size: int = 32
+    gossip_len: int = 8
+    probes: int = 8          # cycle = 4 ticks
+    tfail: int = 8
+    tremove: int = 24
+    ticks: int = 120
+    fail_time: int = 60
+    fanouts: Sequence[int] = tuple(range(1, 9))
+    drop_rates: Sequence[float] = (0.0, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3)
+    seeds: Sequence[int] = (0, 1, 2)
+
+    def to_params(self) -> Params:
+        # fanout here is only the static bound; cells pass theirs dynamically.
+        return Params.from_text(
+            f"MAX_NNB: {self.n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            f"MSG_DROP_PROB: 0\nVIEW_SIZE: {self.view_size}\n"
+            f"GOSSIP_LEN: {self.gossip_len}\nPROBES: {self.probes}\n"
+            f"FANOUT: {max(self.fanouts)}\nTFAIL: {self.tfail}\n"
+            f"TREMOVE: {self.tremove}\nTOTAL_TIME: {self.ticks}\n"
+            f"FAIL_TIME: {self.fail_time}\nJOIN_MODE: warm\n"
+            f"EVENT_MODE: agg\nBACKEND: tpu_hash\n")
+
+
+def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
+    """Execute the grid; returns one record per (fanout, drop, seed)."""
+    params = spec.to_params()
+    cfg = make_config(params, collect_events=False)
+    step = make_step(cfg, dynamic_knobs=True)
+    n, total = spec.n, spec.ticks
+
+    ticks = jnp.arange(total, dtype=I32)
+    start_ticks = jnp.full((n,), -1, I32)            # warm: active from t=0
+    fail_time = jnp.asarray(spec.fail_time, I32)
+    drop_lo = jnp.asarray(-1, I32)                   # drops active all run
+    drop_hi = jnp.asarray(total + 1, I32)
+
+    def one_run(seed, fanout, drop):
+        keys = jax.vmap(lambda t: jax.random.fold_in(
+            jax.random.PRNGKey(seed), t))(ticks)
+        # The crashed node varies with the seed, as Application::fail's
+        # rand() % N does (Application.cpp:182).
+        failed = jax.random.randint(jax.random.PRNGKey(seed ^ 0xFA11),
+                                    (), 0, n, dtype=I32)
+        fail_mask = jnp.zeros((n,), bool).at[failed].set(True)
+        state0 = init_state_warm(cfg, jax.random.PRNGKey(seed ^ 0x5EED))
+
+        def body(state, inp):
+            t, k = inp
+            return step(state, (t, k, start_ticks, fail_mask, fail_time,
+                                drop_lo, drop_hi), fanout, drop)
+
+        final_state, _ = jax.lax.scan(body, state0, (ticks, keys))
+        agg = final_state.agg
+        return {
+            "false_removals": agg.rm_count.sum() - agg.det_count.sum(),
+            "trackers": agg.trackers[failed],
+            "detections": agg.det_count[failed],
+            "tracker_nodes": agg.tracker_obs.sum(),
+            "detecting_trackers": (agg.det_obs & agg.tracker_obs).sum(),
+            "lat_hist": agg.lat_hist,
+            "msgs_sent": agg.sent_total.sum(),
+        }
+
+    grid = [(seed, f, d) for f in spec.fanouts for d in spec.drop_rates
+            for seed in spec.seeds]
+    seeds_a = jnp.asarray([g[0] for g in grid], I32)
+    fanout_a = jnp.asarray([g[1] for g in grid], I32)
+    drop_a = jnp.asarray([g[2] for g in grid], jnp.float32)
+
+    out = jax.jit(jax.vmap(one_run))(seeds_a, fanout_a, drop_a)
+    out = jax.tree.map(np.asarray, out)
+
+    records = []
+    for i, (seed, fanout, drop) in enumerate(grid):
+        hist = out["lat_hist"][i]
+        total_det = int(hist.sum())
+        cdf = np.cumsum(hist)
+        trackers = int(out["tracker_nodes"][i])
+        detecting = int(out["detecting_trackers"][i])
+        records.append({
+            "fanout": int(fanout), "drop_rate": float(drop),
+            "seed": int(seed),
+            "false_removals": int(out["false_removals"][i]),
+            "trackers": trackers,
+            "observer_completeness": detecting / trackers if trackers else 1.0,
+            "detections": int(out["detections"][i]),
+            "latency_p50": (int(np.searchsorted(cdf, 0.5 * total_det))
+                            if total_det else None),
+            "latency_p99": (int(np.searchsorted(cdf, 0.99 * total_det))
+                            if total_det else None),
+            "latency_overflow": int(hist[LAT_BINS - 1]),
+            "msgs_sent": int(out["msgs_sent"][i]),
+        })
+    return records
+
+
+def summarize(records: list[dict]) -> list[dict]:
+    """Collapse seeds: one row per (fanout, drop_rate) cell with means."""
+    cells: dict = {}
+    for r in records:
+        cells.setdefault((r["fanout"], r["drop_rate"]), []).append(r)
+    rows = []
+    for (fanout, drop), rs in sorted(cells.items()):
+        rows.append({
+            "fanout": fanout, "drop_rate": drop, "runs": len(rs),
+            "observer_completeness_mean": float(np.mean(
+                [r["observer_completeness"] for r in rs])),
+            "false_removals_mean": float(np.mean(
+                [r["false_removals"] for r in rs])),
+            "latency_p50_mean": (float(np.mean(
+                [r["latency_p50"] for r in rs
+                 if r["latency_p50"] is not None]))
+                if any(r["latency_p50"] is not None for r in rs) else None),
+            "msgs_sent_mean": float(np.mean([r["msgs_sent"] for r in rs])),
+        })
+    return rows
+
+
+def write_artifacts(records, rows, out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "phase_sweep_runs.json"), "w") as fh:
+        json.dump(records, fh, indent=1)
+    with open(os.path.join(out_dir, "phase_sweep_grid.csv"), "w") as fh:
+        cols = list(rows[0].keys())
+        fh.write(",".join(cols) + "\n")
+        for r in rows:
+            fh.write(",".join(str(r[c]) for c in cols) + "\n")

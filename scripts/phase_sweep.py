@@ -1,0 +1,63 @@
+"""Run the fanout x drop-rate phase-diagram sweep and commit the grid.
+
+Usage:
+  python scripts/phase_sweep.py                  # full 8x7x3 grid
+  python scripts/phase_sweep.py --quick          # 3x3x2 smoke grid
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--n", type=int, default=0)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    platform = resolve_platform(pin=args.platform)
+
+    from distributed_membership_tpu.sweeps.phase import (
+        SweepSpec, run_sweep, summarize, write_artifacts)
+
+    kwargs = {}
+    if args.quick:
+        kwargs = dict(fanouts=(1, 3, 6), drop_rates=(0.0, 0.1, 0.3),
+                      seeds=(0, 1), n=1024)
+    if args.n:
+        kwargs["n"] = args.n
+    spec = SweepSpec(**kwargs)
+
+    t0 = time.time()
+    records = run_sweep(spec)
+    wall = time.time() - t0
+    rows = summarize(records)
+    write_artifacts(records, rows, OUT_DIR)
+    print(json.dumps({
+        "platform": platform, "cells": len(rows), "runs": len(records),
+        "n": spec.n, "wall_seconds": round(wall, 1),
+        "worst_completeness": min(r["observer_completeness_mean"]
+                                  for r in rows),
+    }))
+    for r in rows:
+        print(f"  fanout={r['fanout']} drop={r['drop_rate']:.2f} "
+              f"completeness={r['observer_completeness_mean']:.3f} "
+              f"false={r['false_removals_mean']:.1f} "
+              f"p50={r['latency_p50_mean']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

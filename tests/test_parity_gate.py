@@ -1,0 +1,70 @@
+"""Distributional parity gate (VERDICT r1 item 8; BASELINE.md fidelity row).
+
+The reference is nondeterministic (random_device-seeded gossip), so parity
+is distributional: BASELINE.md measured removal latencies of 21-22 ticks
+(single failure) / 21-23 (multi) after the t=100 crash, across runs.  This
+gate runs every backend over multiple seeds and asserts:
+
+  * every removal latency falls in the reference's measured 21-23 window;
+  * the mean latency is within 5% of the reference's window midpoint;
+  * all 9 survivors detect in every run (completeness, every seed);
+  * backends agree with the `emul` executable spec's distribution (total
+    variation distance over the 3-tick support).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.observability.metrics import removal_latencies
+
+REF_WINDOW = (21, 23)        # BASELINE.md, measured from the C++ reference
+REF_MEAN = 21.5              # midpoint of the measured 21-22 typical case
+SEEDS = (0, 1, 2, 3, 4)
+
+BACKENDS = ["emul_native", "tpu", "tpu_sparse", "tpu_hash", "tpu_sharded",
+            "tpu_hash_sharded"]
+
+_DIST_CACHE: dict = {}
+
+
+def _latency_dist(backend, testcases_dir, seeds=SEEDS):
+    key = (backend, seeds)
+    if key not in _DIST_CACHE:
+        lats = []
+        for seed in seeds:
+            params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+            params.BACKEND = backend
+            result = get_backend(backend)(params, seed=seed)
+            lat = removal_latencies(result.log.dbg_text(), 100)
+            assert len(lat) == 9, (backend, seed, lat)   # completeness
+            lats.extend(lat)
+        _DIST_CACHE[key] = np.asarray(lats)
+    return _DIST_CACHE[key]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_latency_window_and_mean(backend, testcases_dir):
+    lats = _latency_dist(backend, testcases_dir)
+    assert lats.min() >= REF_WINDOW[0], (backend, sorted(lats))
+    assert lats.max() <= REF_WINDOW[1], (backend, sorted(lats))
+    # 5% fidelity target on the mean (BASELINE.md).
+    assert abs(lats.mean() - REF_MEAN) / REF_MEAN <= 0.05, (
+        backend, lats.mean())
+
+
+@pytest.mark.parametrize("backend", [b for b in BACKENDS
+                                     if b != "emul_native"])
+def test_distribution_matches_executable_spec(backend, testcases_dir):
+    """Total-variation distance to the emul_native oracle's distribution
+    over the {21, 22, 23} support stays small."""
+    ref = _latency_dist("emul_native", testcases_dir)
+    got = _latency_dist(backend, testcases_dir)
+    support = range(REF_WINDOW[0], REF_WINDOW[1] + 1)
+    tv = 0.5 * sum(abs((ref == v).mean() - (got == v).mean())
+                   for v in support)
+    # Seeds differ and the reference itself is nondeterministic; across 45
+    # samples a TV distance below 0.25 keeps each backend's mass on the
+    # same one-or-two dominant latencies without flagging seed noise.
+    assert tv <= 0.25, (backend, tv, sorted(ref), sorted(got))

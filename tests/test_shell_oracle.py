@@ -1,0 +1,87 @@
+"""Shell-oracle check: our emitted dbg.log graded by the REAL grep pipelines.
+
+The Python grader (grader.py) is a port; this test removes the port from the
+trust chain by executing the reference grader's actual shell pipelines —
+``grep joined dbg.log | cut -d" " -f2,4-7 | sort -u | wc -l`` and friends,
+verbatim command lines from Grader_verbose.sh:41-77 — with /bin/bash against
+a dbg.log our backends emitted, then asserts both (a) the shell verdicts
+pass and (b) the Python grader agrees check-for-check.
+
+(The full Grader_verbose.sh cannot be invoked directly: it insists on
+``make``-building and running the C++ Application in its own tree,
+Grader_verbose.sh:32-38.  The pipelines below are its complete scoring
+logic for the single-failure scenario, same flags, same field indices.)
+"""
+
+import subprocess
+
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import grade_scenario
+
+
+def _sh(cmd: str, cwd: str) -> str:
+    return subprocess.run(["/bin/bash", "-c", cmd], cwd=cwd,
+                          capture_output=True, text=True,
+                          check=True).stdout.strip()
+
+
+@pytest.mark.parametrize("backend", ["emul", "emul_native", "tpu_hash"])
+def test_shell_pipelines_agree_with_python_grader(tmp_path, testcases_dir,
+                                                  backend):
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = backend
+    result = get_backend(backend)(params, seed=5)
+    (tmp_path / "dbg.log").write_text(result.log.dbg_text())
+    cwd = str(tmp_path)
+
+    # --- Join check (Grader_verbose.sh:41-61) ---
+    joincount = int(_sh(
+        'grep joined dbg.log | cut -d" " -f2,4-7 | sort -u | wc -l', cwd))
+    shell_join = joincount == 100
+    if not shell_join:
+        cnt = 0
+        joinfrom = _sh('grep joined dbg.log | cut -d" " -f2 | sort -u',
+                       cwd).split()
+        for i in joinfrom:
+            jointo = int(_sh(
+                f"grep joined dbg.log | grep '^ '{i} | "
+                f'cut -d" " -f4-7 | grep -v {i} | sort -u | wc -l', cwd))
+            if jointo == 9:
+                cnt += 1
+        shell_join = cnt == 10
+
+    # --- Completeness / accuracy (Grader_verbose.sh:62-77) ---
+    failednode = _sh(
+        "grep \"Node failed at time\" dbg.log | sort -u | awk '{print $1}'",
+        cwd)
+    assert failednode
+    failcount = int(_sh(
+        f"grep removed dbg.log | sort -u | grep {failednode} | wc -l", cwd))
+    accuracycount = int(_sh(
+        f"grep removed dbg.log | sort -u | grep -v {failednode} | wc -l",
+        cwd))
+    shell_completeness = failcount >= 9
+    shell_accuracy = accuracycount == 0 and failcount > 0
+
+    # The run must pass the real oracle outright...
+    assert shell_join and shell_completeness and shell_accuracy, (
+        joincount, failcount, accuracycount)
+
+    # ...and the Python port must agree check-for-check.
+    g = grade_scenario("singlefailure", result.log.dbg_text(), 10)
+    assert g.join_ok == shell_join
+    assert (g.completeness_pts == g.completeness_max) == shell_completeness
+    assert (g.accuracy_pts == g.accuracy_max) == shell_accuracy
+    assert g.passed
+
+
+def test_magic_first_line(tmp_path, testcases_dir):
+    """First dbg.log line is the magic '131' (hex char-sum of 'CS425',
+    Log.cpp:79-88) — graders and tooling key on it."""
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = "emul_native"
+    result = get_backend("emul_native")(params, seed=5)
+    assert result.log.dbg_text().splitlines()[0] == "131"
