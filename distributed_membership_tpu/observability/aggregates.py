@@ -148,11 +148,175 @@ def update_agg(agg: AggStats, *, t: jax.Array,
                     agg.sent_total + sent_tick, agg.recv_total + recv_tick)
 
 
+class FastAgg(NamedTuple):
+    """Scatter-free aggregates for the ring-exchange scale path.
+
+    ``update_agg`` costs three full-width ``[rows*M]``-index scatter-adds per
+    tick (``count_by_id``) — cheap next to the scatter-based message
+    exchange, but the dominant per-tick cost once the exchange itself is
+    roll/gather-based (tpu_hash ``exchange='ring'``).  When the failed-id
+    set is small and known host-side (it always is: the FailurePlan is
+    computed up front, runtime/failures.py), everything the detection
+    summary needs reduces to per-failed-id *elementwise* compares and
+    scalar reductions — no scatters at all.  Per-id ``join_count`` /
+    ``rm_count`` histograms are dropped: the summary only ever consumed
+    their sums, which the per-tick scalar event outputs already carry.
+    """
+    det_count: jax.Array    # [F] i32 — true detections per failed id
+    trackers: jax.Array     # [F] i32 — live views holding id f at fail_time
+    tracker_obs: jax.Array  # [rows] bool — held >=1 crashed id at the crash
+    det_obs: jax.Array      # [rows] bool — issued >=1 true detection
+    lat_hist: jax.Array     # [LAT_BINS] i32
+    join_total: jax.Array   # [] i32 — all join events
+    rm_total: jax.Array     # [] i32 — all removal events (false = rm - det)
+    sent_total: jax.Array   # [rows] i32
+    recv_total: jax.Array   # [rows] i32
+
+
+FAST_AGG_MAX_FAILED = 8     # per-id work is F elementwise passes; beyond
+#                             this the scatter-based AggStats path wins
+
+
+def init_fast_agg(n_failed: int, rows: int) -> FastAgg:
+    return FastAgg(
+        det_count=jnp.zeros((max(n_failed, 1),), I32),
+        trackers=jnp.zeros((max(n_failed, 1),), I32),
+        tracker_obs=jnp.zeros((rows,), bool),
+        det_obs=jnp.zeros((rows,), bool),
+        lat_hist=jnp.zeros((LAT_BINS,), I32),
+        join_total=jnp.zeros((), I32),
+        rm_total=jnp.zeros((), I32),
+        sent_total=jnp.zeros((rows,), I32),
+        recv_total=jnp.zeros((rows,), I32),
+    )
+
+
+def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
+                    join_events: jax.Array, rm_ids: jax.Array,
+                    view_ids: jax.Array, view_present: jax.Array,
+                    fail_time: jax.Array, holder_failed: jax.Array,
+                    sent_tick: jax.Array, recv_tick: jax.Array) -> FastAgg:
+    """One tick, all elementwise/reduce (``fail_ids`` is a STATIC tuple).
+
+    ``join_events``: [rows, M] bool (admissions this tick); ``rm_ids``:
+    [rows, M] member ids (EMPTY = none); ``holder_failed``: [rows] bool
+    crash mask aligned to observer rows (a sharded caller passes its local
+    slice).
+    """
+    rm_mask = rm_ids >= 0
+    post = t > fail_time
+
+    if fail_ids:
+        per_f_rm = [rm_mask & (rm_ids == f) for f in fail_ids]
+        det_tick = jnp.stack(
+            [m.sum(dtype=I32) for m in per_f_rm]) * post.astype(I32)
+        any_true_rm = jnp.zeros(rm_ids.shape[:1], bool)
+        for m in per_f_rm:
+            any_true_rm = any_true_rm | m.any(axis=1)
+
+        def census():
+            live = ~holder_failed[:, None]
+            tr = jnp.stack([(view_present & (view_ids == f) & live)
+                            .sum(dtype=I32) for f in fail_ids])
+            holds = jnp.zeros(view_ids.shape[:1], bool)
+            for f in fail_ids:
+                holds = holds | (view_present & (view_ids == f)).any(axis=1)
+            return tr, holds & ~holder_failed
+
+        trackers, tracker_obs = jax.lax.cond(
+            t == fail_time, census, lambda: (agg.trackers, agg.tracker_obs))
+    else:
+        det_tick = jnp.zeros_like(agg.det_count)
+        any_true_rm = jnp.zeros(rm_ids.shape[:1], bool)
+        trackers, tracker_obs = agg.trackers, agg.tracker_obs
+
+    lat = jnp.clip(t - fail_time, 0, LAT_BINS - 1)
+    return FastAgg(
+        det_count=agg.det_count + det_tick,
+        trackers=trackers,
+        tracker_obs=tracker_obs,
+        det_obs=agg.det_obs | (any_true_rm & post),
+        lat_hist=agg.lat_hist.at[lat].add(det_tick.sum()),
+        join_total=agg.join_total + join_events.sum(dtype=I32),
+        rm_total=agg.rm_total + rm_mask.sum(dtype=I32),
+        sent_total=agg.sent_total + sent_tick,
+        recv_total=agg.recv_total + recv_tick,
+    )
+
+
+def latency_stats(hist: np.ndarray) -> dict:
+    """min/max/p50/p99/overflow/nonzero-bins view of a latency histogram
+    (shared by detection_summary, fast_summary, and the phase sweep)."""
+    hist = np.asarray(hist)
+    total_det = int(hist.sum())
+    if not total_det:
+        return {}
+    ticks = np.arange(hist.shape[0])
+    cdf = np.cumsum(hist)
+    return {
+        "latency_min": int(ticks[hist > 0][0]),
+        "latency_max": int(ticks[hist > 0][-1]),
+        "latency_p50": int(np.searchsorted(cdf, 0.50 * total_det)),
+        "latency_p99": int(np.searchsorted(cdf, 0.99 * total_det)),
+        # Detections at >= LAT_BINS-1 ticks land in the last bin; when
+        # nonzero, max/percentiles at the last bin mean ">= that".
+        "latency_overflow_count": int(hist[hist.shape[0] - 1]),
+        "latency_hist_nonzero": {
+            int(k): int(v) for k, v in zip(ticks[hist > 0], hist[hist > 0])},
+    }
+
+
+def _completeness_stats(trackers: np.ndarray, detections: np.ndarray,
+                        tracker_obs: np.ndarray, det_obs: np.ndarray,
+                        n_failed: int, total_det: int) -> dict:
+    tracker_nodes = int(tracker_obs.sum())
+    detecting = int((det_obs & tracker_obs).sum())
+    return {
+        "failed_nodes": n_failed,
+        "trackers_per_failed_min": int(trackers.min()),
+        "trackers_per_failed_mean": float(trackers.mean()),
+        "detections_total": total_det,
+        # Distinct-observer completeness: of the live nodes that held a
+        # crashed id at the crash, how many issued >= 1 true detection.
+        # (Event-count ratios can overcount via post-crash readmission
+        # churn; this is the honest grader-style criterion.)
+        "tracker_nodes": tracker_nodes,
+        "observer_completeness": (
+            detecting / tracker_nodes if tracker_nodes else 1.0),
+        # Event-count view, per failed id (>=1 event per tracker view).
+        "detection_completeness": float((detections >= trackers).mean()),
+        "detected_by_someone": float((detections > 0).mean()),
+    }
+
+
+def fast_summary(agg: FastAgg, fail_ids, fail_time: int | None) -> dict:
+    """detection_summary for FastAgg — same keys, same criteria."""
+    agg = jax.tree.map(np.asarray, agg)
+    det_total = int(agg.det_count.sum())
+    out = {
+        "n": agg.tracker_obs.shape[0],
+        "joins_total": int(agg.join_total),
+        "false_removals": int(agg.rm_total) - det_total,
+        "msgs_sent": int(agg.sent_total.sum()),
+        "msgs_recv": int(agg.recv_total.sum()),
+    }
+    if fail_time is not None and len(fail_ids):
+        f = len(fail_ids)
+        out.update(_completeness_stats(
+            agg.trackers[:f], agg.det_count[:f], agg.tracker_obs,
+            agg.det_obs, f, int(agg.lat_hist.sum())))
+        out.update(latency_stats(agg.lat_hist))
+    return out
+
+
 def detection_summary(agg: AggStats, fail_mask: np.ndarray,
                       fail_time: int | None) -> dict:
     """Host-side verdicts from the aggregates: the grading oracle's
     completeness/accuracy criteria (Grader_verbose.sh semantics) recast for
     tracker-relative bounded views, plus the latency distribution."""
+    if isinstance(agg, FastAgg):
+        fail_ids = tuple(np.nonzero(np.asarray(fail_mask, bool))[0])
+        return fast_summary(agg, fail_ids, fail_time)
     agg = jax.tree.map(np.asarray, agg)
     fail_mask = np.asarray(fail_mask, bool)
     n = agg.rm_count.shape[0]
@@ -169,43 +333,8 @@ def detection_summary(agg: AggStats, fail_mask: np.ndarray,
     }
     if fail_time is not None and fail_mask.any():
         failed = np.nonzero(fail_mask)[0]
-        trackers = agg.trackers[failed]
-        detections = agg.det_count[failed]
-        hist = agg.lat_hist
-        total_det = int(hist.sum())
-        tracker_nodes = int(agg.tracker_obs.sum())
-        detecting_trackers = int((agg.det_obs & agg.tracker_obs).sum())
-        out.update({
-            "failed_nodes": int(fail_mask.sum()),
-            "trackers_per_failed_min": int(trackers.min()),
-            "trackers_per_failed_mean": float(trackers.mean()),
-            "detections_total": total_det,
-            # Distinct-observer completeness: of the live nodes that held a
-            # crashed id at the crash, how many issued >= 1 true detection.
-            # (Event-count ratios can overcount via post-crash readmission
-            # churn; this is the honest grader-style criterion.)
-            "tracker_nodes": tracker_nodes,
-            "observer_completeness": (
-                detecting_trackers / tracker_nodes if tracker_nodes else 1.0),
-            # Event-count view, per failed id (>=1 event per tracker view).
-            "detection_completeness": (
-                float((detections >= trackers).mean())),
-            "detected_by_someone": float((detections > 0).mean()),
-        })
-        if total_det:
-            ticks = np.arange(LAT_BINS)
-            cdf = np.cumsum(hist)
-            overflow = int(hist[LAT_BINS - 1])
-            out.update({
-                "latency_min": int(ticks[hist > 0][0]),
-                "latency_max": int(ticks[hist > 0][-1]),
-                "latency_p50": int(np.searchsorted(cdf, 0.50 * total_det)),
-                "latency_p99": int(np.searchsorted(cdf, 0.99 * total_det)),
-                # Detections at >= LAT_BINS-1 ticks land in the last bin;
-                # when nonzero, max/percentiles at 511 mean ">= 511".
-                "latency_overflow_count": overflow,
-                "latency_hist_nonzero": {
-                    int(k): int(v) for k, v in zip(ticks[hist > 0],
-                                                   hist[hist > 0])},
-            })
+        out.update(_completeness_stats(
+            agg.trackers[failed], agg.det_count[failed], agg.tracker_obs,
+            agg.det_obs, int(fail_mask.sum()), int(agg.lat_hist.sum())))
+        out.update(latency_stats(agg.lat_hist))
     return out

@@ -30,7 +30,8 @@ import numpy as np
 from distributed_membership_tpu.backends.tpu_hash import (
     HashConfig, I32, init_state_warm, make_config, make_step)
 from distributed_membership_tpu.config import Params
-from distributed_membership_tpu.observability.aggregates import LAT_BINS
+from distributed_membership_tpu.observability.aggregates import (
+    LAT_BINS, latency_stats)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +64,10 @@ def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
     """Execute the grid; returns one record per (fanout, drop, seed)."""
     params = spec.to_params()
     cfg = make_config(params, collect_events=False)
+    # The crashed node is a *traced* per-lane value here, so the sweep needs
+    # the AggStats path (per-id accumulators indexable by a traced id) —
+    # the static-failed-id FastAgg fast path cannot apply.
+    cfg = dataclasses.replace(cfg, fast_agg=False, fail_ids=())
     step = make_step(cfg, dynamic_knobs=True)
     n, total = spec.n, spec.ticks
 
@@ -111,8 +116,7 @@ def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
     records = []
     for i, (seed, fanout, drop) in enumerate(grid):
         hist = out["lat_hist"][i]
-        total_det = int(hist.sum())
-        cdf = np.cumsum(hist)
+        lstats = latency_stats(hist)
         trackers = int(out["tracker_nodes"][i])
         detecting = int(out["detecting_trackers"][i])
         records.append({
@@ -122,10 +126,8 @@ def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
             "trackers": trackers,
             "observer_completeness": detecting / trackers if trackers else 1.0,
             "detections": int(out["detections"][i]),
-            "latency_p50": (int(np.searchsorted(cdf, 0.5 * total_det))
-                            if total_det else None),
-            "latency_p99": (int(np.searchsorted(cdf, 0.99 * total_det))
-                            if total_det else None),
+            "latency_p50": lstats.get("latency_p50"),
+            "latency_p99": lstats.get("latency_p99"),
             "latency_overflow": int(hist[LAT_BINS - 1]),
             "msgs_sent": int(out["msgs_sent"][i]),
         })

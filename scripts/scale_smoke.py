@@ -41,6 +41,10 @@ def main() -> int:
     ap.add_argument("--probes", type=int, default=8)
     ap.add_argument("--fanout", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--exchange", default="auto",
+                    choices=["auto", "scatter", "ring"],
+                    help="tpu_hash message-exchange lowering (auto picks "
+                         "the ring fast path for this warm scale config)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="mesh size for tpu_hash_sharded (0 = all devices); "
                          "forces the 8-device virtual CPU mesh when no "
@@ -70,7 +74,10 @@ def main() -> int:
     cycle = -(-args.view // args.probes)
     tfail = 2 * cycle
     tremove = 5 * cycle
-    fail_time = args.ticks - tremove - 4 * cycle
+    # 7 cycles of tail margin: refresh chains stretch the last detections
+    # past TREMOVE (tests/test_hash_backend.py bounds; ring runs a little
+    # longer-tailed than scatter).
+    fail_time = args.ticks - tremove - 7 * cycle
     assert fail_time > 0, "ticks too short for the detection window"
 
     params = Params.from_text(
@@ -79,7 +86,8 @@ def main() -> int:
         f"GOSSIP_LEN: {args.gossip}\nPROBES: {args.probes}\n"
         f"FANOUT: {args.fanout}\nTFAIL: {tfail}\nTREMOVE: {tremove}\n"
         f"TOTAL_TIME: {args.ticks}\nFAIL_TIME: {fail_time}\n"
-        f"JOIN_MODE: warm\nEVENT_MODE: agg\nBACKEND: {args.backend}\n")
+        f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: {args.exchange}\n"
+        f"BACKEND: {args.backend}\n")
 
     t0 = time.time()
     result = get_backend(args.backend)(params, seed=args.seed)
@@ -96,6 +104,12 @@ def main() -> int:
         "view_size": args.view, "gossip_len": args.gossip,
         "probes": args.probes, "fanout": args.fanout,
         "tfail": tfail, "tremove": tremove, "seed": args.seed,
+        # EXCHANGE only drives the tpu_hash backend; the sharded backend
+        # uses its bucketed all_to_all, tpu_sparse its sorted mailboxes.
+        "exchange": (params.resolved_exchange()
+                     if args.backend == "tpu_hash"
+                     else {"tpu_hash_sharded": "bucketed_all_to_all",
+                           "tpu_sparse": "sorted_mailbox"}[args.backend]),
         "wall_seconds": round(wall, 2),
         "node_ticks_per_sec": round(args.n * args.ticks / wall, 1),
         "verdict_ok": ok,

@@ -21,10 +21,14 @@ from distributed_membership_tpu.runtime.failures import make_plan
 
 
 def _params(backend, n=128, extra=""):
+    # EXCHANGE scatter: this file validates the AggStats accumulators,
+    # whose per-id fields the ring fast path intentionally drops
+    # (FastAgg; covered by tests/test_hash_backend.py's ring tests).
     return Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nTOTAL_TIME: 150\n"
-        f"FAIL_TIME: 100\nJOIN_MODE: warm\nBACKEND: {backend}\n" + extra)
+        f"FAIL_TIME: 100\nJOIN_MODE: warm\nEXCHANGE: scatter\n"
+        f"BACKEND: {backend}\n" + extra)
 
 
 @pytest.mark.parametrize("backend", ["tpu_sparse", "tpu_hash"])
@@ -120,3 +124,30 @@ def test_resolved_event_mode_threshold():
     assert p2.resolved_event_mode() == "agg"
     p2.EVENT_MODE = "full"
     assert p2.resolved_event_mode() == "full"
+
+
+def test_ring_with_aggstats_many_failures():
+    """Ring exchange + AggStats: beyond FAST_AGG_MAX_FAILED crashed nodes
+    the ring fast path must fall back to the scatter-add AggStats
+    accumulators and still produce clean verdicts."""
+    from distributed_membership_tpu.observability.aggregates import FastAgg
+
+    n = 128
+    params = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nTOTAL_TIME: 200\n"
+        f"FAIL_TIME: 120\nJOIN_MODE: warm\nEXCHANGE: ring\n"
+        f"RACK_SIZE: 8\nRACK_FAILURES: 2\nEVENT_MODE: agg\n"
+        f"BACKEND: tpu_hash\n")
+    plan = make_plan(params, random.Random("app:0"))
+    assert len(plan.failed_indices) == 16      # > FAST_AGG_MAX_FAILED
+    mod = __import__("distributed_membership_tpu.backends.tpu_hash",
+                     fromlist=["run_scan"])
+    fs, _ = mod.run_scan(params, plan, seed=0, collect_events=False)
+    assert not isinstance(fs.agg, FastAgg)     # AggStats fallback
+    fail_mask = np.zeros(n, bool)
+    fail_mask[plan.failed_indices] = True
+    s = detection_summary(fs.agg, fail_mask, plan.fail_time)
+    assert s["false_removals"] == 0
+    assert s["failed_nodes"] == 16
+    assert s["detected_by_someone"] == 1.0

@@ -39,21 +39,27 @@ def test_removal_latency_in_reference_window(testcases_dir):
 
 
 def _scale_run(n=256, s=32, g=8, probes=8, tfail=10, tremove=30,
-               total=150, fail_time=100, seed=0, extra=""):
+               total=150, fail_time=100, seed=0, exchange="scatter",
+               extra=""):
     # Probe cycle = ceil(S/PROBES) ticks; TFAIL/TREMOVE sized in cycles.
     p = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
         f"TFAIL: {tfail}\nTREMOVE: {tremove}\n"
         f"TOTAL_TIME: {total}\nFAIL_TIME: {fail_time}\n"
-        f"JOIN_MODE: warm\nBACKEND: tpu_hash\n" + extra)
+        f"JOIN_MODE: warm\nEXCHANGE: {exchange}\nBACKEND: tpu_hash\n" + extra)
     plan = make_plan(p, random.Random(f"app:{seed}"))
     final_state, events = run_scan(p, plan, seed=seed)
     return p, plan, final_state, events
 
 
-def test_scale_detection_no_false_positives():
-    p, plan, fs, ev = _scale_run()
+@pytest.mark.parametrize("exchange", ["scatter", "ring"])
+def test_scale_detection_no_false_positives(exchange):
+    # Ring's refresh-chain tail is a little longer-tailed (shared circulant
+    # shifts vs iid target sets), so it gets a longer run and bound.
+    total = 150 if exchange == "scatter" else 200
+    slack = 4 if exchange == "scatter" else 7
+    p, plan, fs, ev = _scale_run(exchange=exchange, total=total)
     failed = plan.failed_indices[0]
     rm = np.asarray(ev.rm_ids)
     true_lat, false_rm = [], []
@@ -66,18 +72,20 @@ def test_scale_detection_no_false_positives():
     # ~S viewers track the failed node; they all detect at ~TREMOVE.
     assert len(true_lat) >= p.VIEW_SIZE // 2, len(true_lat)
     cycle = -(-p.VIEW_SIZE // p.PROBES)
-    assert max(true_lat) <= p.TREMOVE + 4 * cycle, sorted(true_lat)[-5:]
+    assert max(true_lat) <= p.TREMOVE + slack * cycle, sorted(true_lat)[-5:]
     assert min(true_lat) >= p.TFAIL, sorted(true_lat)[:5]
 
 
-def test_sticky_admission_views_are_stable():
+@pytest.mark.parametrize("exchange", ["scatter", "ring"])
+def test_sticky_admission_views_are_stable(exchange):
     # In a failure-free steady state, views must not churn: the occupant
     # set at mid-run equals the occupant set at the end (no silent
     # eviction — the property a blind heartbeat-max combine lacks).
     p = Params.from_text(
         "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         "VIEW_SIZE: 32\nGOSSIP_LEN: 8\nPROBES: 8\nTFAIL: 10\nTREMOVE: 30\n"
-        "TOTAL_TIME: 120\nFAIL_TIME: 1000\nJOIN_MODE: warm\nBACKEND: tpu_hash\n")
+        "TOTAL_TIME: 120\nFAIL_TIME: 1000\nJOIN_MODE: warm\n"
+        f"EXCHANGE: {exchange}\nBACKEND: tpu_hash\n")
     plan = make_plan(p, random.Random("app:0"))
     plan.fail_time = None
     _, ev = run_scan(p, plan, seed=0)
@@ -89,9 +97,10 @@ def test_sticky_admission_views_are_stable():
     assert late_joins == 0, late_joins
 
 
-def test_rack_failure_detected():
+@pytest.mark.parametrize("exchange", ["scatter", "ring"])
+def test_rack_failure_detected(exchange):
     p, plan, fs, ev = _scale_run(
-        n=256, total=200, fail_time=120,
+        n=256, total=200, fail_time=120, exchange=exchange,
         extra="RACK_SIZE: 16\nRACK_FAILURES: 2\n")
     assert plan.kind == "racks" and len(plan.failed_indices) == 32
     rm = np.asarray(ev.rm_ids)
@@ -105,9 +114,10 @@ def test_rack_failure_detected():
     assert len(detections) >= 28, len(detections)
 
 
-def test_drop_window_tolerated():
+@pytest.mark.parametrize("exchange", ["scatter", "ring"])
+def test_drop_window_tolerated(exchange):
     p, plan, fs, ev = _scale_run(
-        total=200, fail_time=140, seed=1,
+        total=200, fail_time=140, seed=1, exchange=exchange,
         extra="DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 20\nDROP_STOP: 120\n")
     failed = plan.failed_indices[0]
     rm = np.asarray(ev.rm_ids)
@@ -120,3 +130,62 @@ def test_drop_window_tolerated():
     assert true_det >= p.VIEW_SIZE // 2
     # 10% loss is within the probe/ack redundancy margin: no false removals.
     assert false_det == 0, false_det
+
+
+def test_ring_fast_agg_matches_stacked_events():
+    """The scatter-free FastAgg path (ring exchange, static failed ids)
+    must agree exactly with the stacked-event oracle on the same
+    trajectory: same seed + same step path => identical events, so join
+    totals, detection counts, and the latency histogram must match."""
+    from distributed_membership_tpu.observability.aggregates import (
+        FastAgg, detection_summary)
+
+    p, plan, fs_ev, ev = _scale_run(n=128, total=180, exchange="ring")
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    ev_lat = [int(t) - plan.fail_time
+              for t, i, s in zip(*np.nonzero(rm != -1))
+              if rm[t, i, s] == failed and t > plan.fail_time]
+
+    params = Params.from_text(
+        "MAX_NNB: 128\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 32\nGOSSIP_LEN: 8\nPROBES: 8\nTFAIL: 10\nTREMOVE: 30\n"
+        "TOTAL_TIME: 180\nFAIL_TIME: 100\nJOIN_MODE: warm\n"
+        "EXCHANGE: ring\nBACKEND: tpu_hash\n")
+    plan2 = make_plan(params, random.Random("app:0"))
+    assert plan2.failed_indices == plan.failed_indices
+    fs_agg, _ = run_scan(params, plan2, seed=0, collect_events=False)
+    assert isinstance(fs_agg.agg, FastAgg)
+
+    fail_mask = np.zeros((128,), bool)
+    fail_mask[plan.failed_indices] = True
+    summary = detection_summary(fs_agg.agg, fail_mask, plan.fail_time)
+    assert summary["false_removals"] == 0
+    assert summary["detections_total"] == len(ev_lat)
+    assert summary["joins_total"] == int(np.asarray(ev.join_ids != -1).sum())
+    hist = {int(k): int(v)
+            for k, v in summary["latency_hist_nonzero"].items()}
+    from collections import Counter
+    assert hist == dict(Counter(ev_lat))
+
+
+def test_ring_scatter_distribution_parity():
+    """Ring's detection-latency distribution stays on scatter's (the
+    BASELINE.md 5% fidelity criterion applied between exchange modes)."""
+    from distributed_membership_tpu.observability.aggregates import (
+        detection_summary)
+
+    p50 = {}
+    for exchange in ("scatter", "ring"):
+        lats = []
+        for seed in (0, 1, 2):
+            p, plan, fs, ev = _scale_run(total=200, seed=seed,
+                                         exchange=exchange)
+            failed = plan.failed_indices[0]
+            rm = np.asarray(ev.rm_ids)
+            lats.extend(int(t) - plan.fail_time
+                        for t, i, s in zip(*np.nonzero(rm != -1))
+                        if rm[t, i, s] == failed and t > plan.fail_time)
+        lats = np.asarray(sorted(lats))
+        p50[exchange] = np.median(lats)
+    assert abs(p50["ring"] - p50["scatter"]) / p50["scatter"] <= 0.05, p50
