@@ -153,6 +153,30 @@ def unpack(cfg: HashConfig, packed: jax.Array):
     return jnp.where(present, member, EMPTY), jnp.where(present, hb, -1), present
 
 
+def make_admit(n: int, self_slot_mask: jax.Array, row_ids: jax.Array):
+    """The sticky admit-or-refresh combine (module docstring), shared by
+    every step builder (single-chip scatter/ring and both sharded steps).
+
+    Occupied slots accept only updates for their current occupant's id;
+    empty slots admit the incoming winner.  The self slot is
+    occupied-by-self from the start: it admits only the node's own id even
+    while empty, so no foreign id is ever evicted by the self refresh —
+    preserving the sticky-admission invariant (the only eviction is the
+    TREMOVE sweep).  ``row_ids`` are the global node ids of the local rows
+    (``arange(N)`` single-chip; the shard's row range sharded).
+    """
+    def admit(view: jax.Array, incoming: jax.Array) -> jax.Array:
+        in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
+        occupied = view > 0
+        matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+        ok = jnp.where(self_slot_mask, in_id == row_ids[:, None],
+                       ~occupied | matches)
+        take = (incoming > 0) & ok
+        return jnp.where(take, jnp.maximum(view, incoming), view)
+
+    return admit
+
+
 def _scatter_msgs(cfg: HashConfig, mail: jax.Array, tgt: jax.Array,
                   msg_id: jax.Array, msg_hb: jax.Array,
                   msg_valid: jax.Array) -> jax.Array:
@@ -291,26 +315,13 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             ctrl_kept = jnp.ones((2, n), bool)
 
         # ---- pass 1: receive = elementwise admit-or-refresh combine ----
-        # Occupied slots accept only their occupant's id (sticky admission,
-        # module docstring); empty slots admit the incoming winner.  Acks
-        # apply first: their channel is collision-free, and an occupant
-        # whose slot the gossip winner contends for still gets its refresh.
+        # (make_admit: sticky admission.)  Acks apply first: their channel
+        # is collision-free, and an occupant whose slot the gossip winner
+        # contends for still gets its refresh.
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = recv_mask[:, None]
         prev_id, _, prev_present = unpack(cfg, state.view)
-
-        def admit(view, incoming):
-            in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
-            occupied = view > 0
-            matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-            # The self slot is occupied-by-self from the start: it admits only
-            # the node's own id even while empty, so no foreign id is ever
-            # evicted by the self refresh — preserving the sticky-admission
-            # invariant (module docstring: the only eviction is TREMOVE).
-            ok = jnp.where(self_slot_mask, in_id == idx[:, None],
-                           ~occupied | matches)
-            take = (incoming > 0) & ok
-            return jnp.where(take, jnp.maximum(view, incoming), view)
+        admit = make_admit(n, self_slot_mask, idx)
 
         if ring:
             view = jnp.where(rcol, admit(state.view, state.mail), state.view)
@@ -455,10 +466,19 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 r = shifts[j]
                 payload = jnp.where(m, view, U32(0))
                 rolled = jnp.roll(payload, r, axis=0)
-                rolled = jnp.roll(rolled,
-                                  jax.lax.rem(jax.lax.rem(r, s) * cstride, s),
-                                  axis=1)
-                mail = jnp.maximum(mail, rolled)
+                # Column alignment: receiver slot = sender slot +
+                # delta*STRIDE with delta = r for unwrapped receiver rows
+                # (j >= r) and r - N for wrapped ones (j < r) — two rolls
+                # selected per row.  (They coincide iff N*STRIDE % S == 0;
+                # relying on that silently corrupts delivery for N not a
+                # multiple of S.)
+                s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+                s2 = jax.lax.rem(
+                    jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s)
+                r1 = jnp.roll(rolled, s1, axis=1)
+                r2 = jnp.roll(rolled, s2, axis=1)
+                mail = jnp.maximum(mail, jnp.where((idx >= r)[:, None],
+                                                   r1, r2))
                 cnt = m.sum(1, dtype=I32)
                 sent_gossip = sent_gossip + cnt
                 recv_add = recv_add + jnp.roll(cnt, r)

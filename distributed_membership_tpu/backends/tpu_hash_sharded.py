@@ -42,6 +42,12 @@ entry subsets; the tick keys themselves are replicated inputs.  Parity with
 single-chip `tpu_hash` is therefore distributional (same protocol, same
 fanout distribution), verified by the grader scenarios and the removal-
 latency window tests (tests/test_hash_sharded.py).
+
+**Exchange modes.**  The bucketed all_to_all above is the ``scatter``
+lowering.  ``EXCHANGE: ring`` (auto-selected for warm bounded-view scale
+runs, as on `tpu_hash`) replaces it with torus-product circulant gossip —
+one static-perm ``ppermute`` payload per shift — and the gather-pipeline
+probe/ack channel; see :func:`make_ring_sharded_step`.
 """
 
 from __future__ import annotations
@@ -60,13 +66,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_hash import (
-    HashConfig, I32, U32, make_config, pack, slot_of, unpack)
+    STRIDE, HashConfig, I32, U32, make_admit, make_config, pack, slot_of,
+    unpack)
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
-    AggStats, init_agg, update_agg)
+    AggStats, FastAgg, init_agg, init_fast_agg, update_agg, update_fast_agg)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
@@ -102,14 +109,22 @@ class ShardedHashState(NamedTuple):
     joinreq_infl: jax.Array
     joinrep_infl: jax.Array
     pending_recv: jax.Array
-    agg: AggStats        # per-shard partials over GLOBAL ids ([N]-shaped);
-    #                      psum-reduced once after the scan
+    agg: AggStats        # per-shard partials over GLOBAL ids ([N]-shaped,
+    #                      or FastAgg on the ring fast path); reduced once
+    #                      after the scan
+    probe_ids1: jax.Array    # [L, P] u32 ids probed last tick (ring mode;
+    #                          [1, 1] zeros otherwise), 0 = none
+    probe_ids2: jax.Array    # [L, P] u32 ids probed two ticks ago (ring)
+    act_prev: jax.Array      # [L] bool act mask of the previous tick (ring)
 
 
 def init_local_state(cfg: HashConfig, n_local: int) -> ShardedHashState:
     s = cfg.s
+    ring = cfg.exchange == "ring"
+    probe_shape = (n_local, cfg.probes) if ring and cfg.probes > 0 else (1, 1)
     return ShardedHashState(
-        agg=init_agg(cfg.n, n_local),
+        agg=(init_fast_agg(len(cfg.fail_ids), n_local) if cfg.fast_agg
+             else init_agg(cfg.n, n_local)),
         view=jnp.zeros((n_local, s), U32),
         view_ts=jnp.zeros((n_local, s), I32),
         started=jnp.zeros((n_local,), bool),
@@ -117,11 +132,14 @@ def init_local_state(cfg: HashConfig, n_local: int) -> ShardedHashState:
         failed=jnp.zeros((n_local,), bool),
         self_hb=jnp.zeros((n_local,), I32),
         mail=jnp.zeros((n_local, s), U32),
-        amail=jnp.zeros((n_local, s), U32),
-        pmail=jnp.zeros((n_local, cfg.qp), U32),
+        amail=jnp.zeros((n_local, s) if not ring else (1, 1), U32),
+        pmail=jnp.zeros((n_local, cfg.qp) if not ring else (1, 1), U32),
         joinreq_infl=jnp.zeros((n_local,), bool),
         joinrep_infl=jnp.zeros((n_local,), bool),
         pending_recv=jnp.zeros((n_local,), I32),
+        probe_ids1=jnp.zeros(probe_shape, U32),
+        probe_ids2=jnp.zeros(probe_shape, U32),
+        act_prev=jnp.zeros((n_local,) if ring else (1,), bool),
     )
 
 
@@ -165,6 +183,238 @@ def bucket_capacity(cfg: HashConfig, n_local: int, n_shards: int) -> int:
     return min(cap, n_local * per_sender + seed_total)
 
 
+def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
+    """Ring exchange on the sharded backend (EXCHANGE ring, JOIN_MODE warm).
+
+    Gossip shifts are torus-product translations ``(j, d) -> (j+c, d+b)``
+    with ``u = b*L + c ~ U[1, N)`` re-drawn per shift per tick: the block
+    part rides ONE static-perm ``ppermute`` (``lax.switch`` over the traced
+    ``b`` — D branches; every shard takes the same branch because the shift
+    key is replicated), the intra part is a local ``jnp.roll``, and slot
+    alignment is two column rolls selected per row (the sender→receiver
+    global-id delta changes by L across the row wrap and by N across the
+    block wrap, a per-shard constant).  Wire cost per shift is exactly one
+    [L, S] payload — no bucket sort, no all_to_all, no scatter.
+
+    Probes/acks use `tpu_hash`'s gather pipeline with one [N] ``all_gather``
+    of the lagged heartbeat vector per tick (4 MB at N=1M — the whole
+    cross-shard probe subsystem).  Per-node probe counters use prober
+    attribution (per-target attribution would need [N] psums per tick);
+    totals remain comparable.  The join/seed machinery is skipped — warm
+    mode is enforced by run_scan_sharded, where it is inert anyway.
+
+    The union of ``fanout`` torus translations re-drawn each tick is an
+    expander family with uniform target marginals, like the single-chip
+    circulant ring (backends/tpu_hash.py make_step).  Pinned by
+    tests/test_hash_sharded.py: the warm scale tests run both exchanges,
+    and test_mesh_matches_single_chip_distribution compares this path's
+    latency distribution against single-chip `tpu_hash` (both on ring via
+    EXCHANGE auto).
+    """
+    n, s, g = cfg.n, cfg.s, cfg.g
+    k_max = min(cfg.fanout, s)
+    l_idx = jnp.arange(n_local, dtype=I32)
+    use_drop = cfg.drop_prob > 0.0
+    p_red = 1 if cfg.qp >= n else 2
+    cstride = STRIDE % s
+    if cfg.probes >= s:
+        raise ValueError("ring mode needs PROBES < VIEW_SIZE "
+                         f"(got {cfg.probes} >= {s})")
+
+    def block_send(tensors, b):
+        """Route tensors to shard (me + b) — switch over D static perms."""
+        def mk(i):
+            if i == 0:
+                return lambda ops: ops
+            perm = [(src, (src + i) % n_shards) for src in range(n_shards)]
+            return lambda ops: tuple(
+                lax.ppermute(o, NODE_AXIS, perm) for o in ops)
+        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+
+    def step(state: ShardedHashState, inputs):
+        t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = inputs
+        me = lax.axis_index(NODE_AXIS)
+        row0 = (me * n_local).astype(I32)
+        lrows = row0 + l_idx
+        fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
+        start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,), (n_local,))
+        key_l = jax.random.fold_in(key, me)
+        k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(key_l, 4)
+        k_shifts = jax.random.fold_in(key, 0x517F)     # replicated stream
+        self_slot = slot_of(cfg, lrows, lrows)
+        self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == self_slot[:, None]
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+
+        # ---- receive ----
+        recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        rcol = recv_mask[:, None]
+        prev_present = state.view > 0
+
+        admit = make_admit(n, self_slot_mask, lrows)
+        view = jnp.where(rcol, admit(state.view, state.mail), state.view)
+        changed = view > state.view
+        view_ts = jnp.where(changed, t, state.view_ts)
+        mail = jnp.where(rcol, 0, state.mail)
+        cur_id, cur_hb, present = unpack(cfg, view)
+        join_mask = changed & ~prev_present
+        join_ids = jnp.where(join_mask, cur_id, EMPTY)
+
+        # ---- ack application (probes issued at t-2; tpu_hash pipeline) ----
+        ack_recv_cnt = jnp.zeros((n_local,), I32)
+        if cfg.probes > 0:
+            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)     # [N]
+            ids2 = state.probe_ids2
+            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+            hb_ack = vec_g[id2]
+            valid2 = (ids2 > 0) & (hb_ack > 0) & rcol
+            if use_drop:
+                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                valid2 &= ~(jax.random.bernoulli(
+                    k_ack2, cfg.drop_prob, ids2.shape) & da_ack)
+            cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+            ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
+            full = jnp.concatenate(
+                [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
+            full = jnp.roll(full, ptr2, axis=1)
+            c_id = ((full - U32(1)) % U32(n)).astype(I32)
+            match = (full > 0) & (view > 0) & (c_id == cur_id)
+            upd = match & (full > view)
+            view = jnp.where(upd, full, view)
+            view_ts = jnp.where(upd, t, view_ts)
+            cur_id, cur_hb, present = unpack(cfg, view)
+            ack_recv_cnt = valid2.sum(1, dtype=I32)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        # ---- self refresh ----
+        act = (state.started & (t > start_ticks_l) & ~state.failed
+               & state.in_group)
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        old_self = view[l_idx, self_slot]
+        view = view.at[l_idx, self_slot].set(
+            jnp.where(act, pack(cfg, own_hb, lrows), old_self))
+        view_ts = view_ts.at[l_idx, self_slot].set(
+            jnp.where(act, t, view_ts[l_idx, self_slot]))
+        cur_id, cur_hb, present = unpack(cfg, view)
+
+        # ---- TFAIL / TREMOVE sweep ----
+        difft = t - view_ts
+        stale = present & (difft >= cfg.tfail) & act[:, None]
+        numfailed = stale.sum(1, dtype=I32)
+        removes = stale & (difft >= cfg.tremove)
+        rm_ids = jnp.where(removes, cur_id, EMPTY)
+        view = jnp.where(removes, 0, view)
+        present = present & ~removes
+
+        # ---- gossip: torus-product circulant shifts ----
+        size = present.sum(1, dtype=I32)
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = cur_id == lrows[:, None]
+        k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
+        if g >= s:
+            keep = fresh
+        else:
+            fresh_cnt = fresh.sum(1, dtype=I32)
+            p_keep = jnp.where(
+                fresh_cnt > 1,
+                (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
+                1.0)
+            u_keep = jax.random.uniform(k_entries, (n_local, s))
+            keep = fresh & ((u_keep < p_keep[:, None]) | is_self_slot)
+        keep = keep & act[:, None]
+
+        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        sent_gossip = jnp.zeros((n_local,), I32)
+        recv_add = jnp.zeros((n_local,), I32)
+        for j in range(k_max):
+            m = keep & (j < k_eff)[:, None]
+            if use_drop:
+                m = m & ~(jax.random.bernoulli(
+                    jax.random.fold_in(k_dropg, j), cfg.drop_prob,
+                    (n_local, s)) & drop_active)
+            payload = jnp.where(m, view, U32(0))
+            cnt = m.sum(1, dtype=I32)
+            sent_gossip = sent_gossip + cnt
+            u = shifts[j]
+            b = u // n_local
+            c = lax.rem(u, n_local)
+            payload_r, cnt_r = block_send((payload, cnt), b)
+            payload_r = jnp.roll(payload_r, c, axis=0)
+            cnt_r = jnp.roll(cnt_r, c, axis=0)
+            # Column alignment: receiver slot = sender slot + delta*STRIDE,
+            # delta = b'*L + c' with b' = b - D on block wrap (receiving
+            # shards me < b) and c' = c - L on row wrap (rows jd < c).
+            bp = jnp.where(me < b, b - n_shards, b)
+            base1 = lax.rem(lax.rem(bp * n_local + c, s) + s, s)
+            base2 = lax.rem(lax.rem(bp * n_local + c - n_local, s) + s, s)
+            r1 = jnp.roll(payload_r, lax.rem(base1 * cstride, s), axis=1)
+            r2 = jnp.roll(payload_r, lax.rem(base2 * cstride, s), axis=1)
+            result = jnp.where((l_idx >= c)[:, None], r1, r2)
+            mail = jnp.maximum(mail, result)
+            recv_add = recv_add + cnt_r
+        sent_tick = sent_gossip
+
+        # ---- probe issue ----
+        probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
+        act_prev = state.act_prev
+        if cfg.probes > 0:
+            ptr = lax.rem(t * cfg.probes, s)
+            window = jnp.roll(view, -ptr, axis=1)[:, :cfg.probes]
+            w_pres = window > 0
+            w_id = ((window - U32(1)) % U32(n)).astype(I32)
+            p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
+            if use_drop:
+                p_valid = p_valid & ~(jax.random.bernoulli(
+                    k_probe_drop, cfg.drop_prob, p_valid.shape) & drop_active)
+            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+            probe_ids2, probe_ids1 = probe_ids1, ids_new
+            act_prev = act
+            sent_probes = p_valid.sum(1, dtype=I32) * p_red
+            in_flight = (state.probe_ids1 > 0).sum(1, dtype=I32)
+            sent_tick = sent_tick + sent_probes + in_flight
+            recv_add = recv_add + in_flight * p_red + ack_recv_cnt
+
+        pending_recv = pending_recv + recv_add
+        failed = state.failed | (fail_mask_l & (t == fail_time))
+
+        if cfg.collect_events:
+            agg = state.agg
+            out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
+        else:
+            if cfg.fast_agg:
+                agg = update_fast_agg(
+                    state.agg, t=t, fail_ids=cfg.fail_ids,
+                    join_events=join_mask, rm_ids=rm_ids,
+                    view_ids=cur_id, view_present=present,
+                    fail_time=fail_time, holder_failed=fail_mask_l,
+                    sent_tick=sent_tick, recv_tick=recv_tick)
+            else:
+                agg = update_agg(
+                    state.agg, t=t, join_ids=join_ids, rm_ids=rm_ids,
+                    view_ids=cur_id, view_present=present,
+                    fail_mask=fail_mask_g, fail_time=fail_time,
+                    sent_tick=sent_tick, recv_tick=recv_tick,
+                    holder_failed=fail_mask_l)
+            out = SparseTickEvents(
+                lax.psum((join_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
+                lax.psum((rm_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
+                lax.psum(sent_tick.sum(dtype=I32), NODE_AXIS),
+                lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
+
+        new_state = ShardedHashState(
+            view, view_ts, state.started, state.in_group, failed, self_hb,
+            mail, state.amail, state.pmail, state.joinreq_infl,
+            state.joinrep_infl, pending_recv, agg,
+            probe_ids1, probe_ids2, act_prev)
+        return new_state, out
+
+    return step
+
+
 def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
     n, s, g = cfg.n, cfg.s, cfg.g
     k_max = min(cfg.fanout, s)
@@ -196,15 +446,7 @@ def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
         rcol = recv_mask[:, None]
         prev_id, _, prev_present = unpack(cfg, state.view)
 
-        def admit(view, incoming):
-            in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
-            occupied = view > 0
-            matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-            ok = jnp.where(self_slot_mask, in_id == lrows[:, None],
-                           ~occupied | matches)
-            take = (incoming > 0) & ok
-            return jnp.where(take, jnp.maximum(view, incoming), view)
-
+        admit = make_admit(n, self_slot_mask, lrows)
         view = jnp.where(rcol, admit(state.view, state.amail), state.view)
         view = jnp.where(rcol, admit(view, state.mail), view)
         changed = view > state.view
@@ -483,7 +725,8 @@ def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
 
         new_state = ShardedHashState(
             view, view_ts, started, in_group, failed, self_hb, mail, amail,
-            pmail, joinreq_infl, joinrep_infl, pending_recv, agg)
+            pmail, joinreq_infl, joinrep_infl, pending_recv, agg,
+            state.probe_ids1, state.probe_ids2, state.act_prev)
         return new_state, out
 
     return step
@@ -491,6 +734,21 @@ def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
 
 def boolean_any(x: jax.Array) -> jax.Array:
     return x.any()
+
+
+def reduce_fast_agg(agg: FastAgg) -> FastAgg:
+    """Reduce per-shard FastAgg partials to the replicated global value."""
+    return FastAgg(
+        det_count=lax.psum(agg.det_count, NODE_AXIS),
+        trackers=lax.psum(agg.trackers, NODE_AXIS),
+        tracker_obs=lax.all_gather(agg.tracker_obs, NODE_AXIS, tiled=True),
+        det_obs=lax.all_gather(agg.det_obs, NODE_AXIS, tiled=True),
+        lat_hist=lax.psum(agg.lat_hist, NODE_AXIS),
+        join_total=lax.psum(agg.join_total, NODE_AXIS),
+        rm_total=lax.psum(agg.rm_total, NODE_AXIS),
+        sent_total=lax.all_gather(agg.sent_total, NODE_AXIS, tiled=True),
+        recv_total=lax.all_gather(agg.recv_total, NODE_AXIS, tiled=True),
+    )
 
 
 def reduce_agg(agg: AggStats) -> AggStats:
@@ -519,7 +777,9 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     cache_key = (cfg, n_local, mesh, warm)
     if cache_key not in _RUNNER_CACHE:
         n_shards = mesh.shape[NODE_AXIS]
-        step = make_sharded_step(cfg, n_local, n_shards)
+        ring = cfg.exchange == "ring"
+        step = (make_ring_sharded_step if ring
+                else make_sharded_step)(cfg, n_local, n_shards)
 
         def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
                       drop_lo, drop_hi, warm_key):
@@ -534,12 +794,14 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
             final_state, out = lax.scan(body, state0, (ticks, keys))
             if not cfg.collect_events:
                 final_state = final_state._replace(
-                    agg=reduce_agg(final_state.agg))
+                    agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
+                        final_state.agg))
             return final_state, out
 
         # The reduced (or untouched-zero) agg is replicated; everything
         # else is node-sharded.
-        agg_spec = AggStats(*(P() for _ in AggStats._fields))
+        agg_t = FastAgg if cfg.fast_agg else AggStats
+        agg_spec = agg_t(*(P() for _ in agg_t._fields))
         state_spec = ShardedHashState(
             **{f: (agg_spec if f == "agg" else P(NODE_AXIS))
                for f in ShardedHashState._fields})
@@ -569,7 +831,13 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     if n % d != 0:
         raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
     n_local = n // d
-    cfg = make_config(params, collect_events)
+    fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
+    cfg = make_config(params, collect_events, fail_ids=fail_ids)
+    if cfg.exchange == "ring" and params.JOIN_MODE != "warm":
+        # The ring step skips the cold-join handshake machinery (inert in
+        # warm mode); EXCHANGE auto never selects this combination.
+        raise ValueError("EXCHANGE ring on tpu_hash_sharded requires "
+                         "JOIN_MODE warm")
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"

@@ -189,3 +189,19 @@ def test_ring_scatter_distribution_parity():
         lats = np.asarray(sorted(lats))
         p50[exchange] = np.median(lats)
     assert abs(p50["ring"] - p50["scatter"]) / p50["scatter"] <= 0.05, p50
+
+
+def test_ring_wrap_alignment_n_not_multiple_of_s():
+    """Regression: single-chip ring delivery with N=100, S=32 (wrapped
+    receiver rows need the r - N column shift).  Misalignment shows up as
+    admissions at wrong slots -> view churn -> false removals."""
+    p, plan, fs, ev = _scale_run(n=100, total=200, exchange="ring")
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    false_rm = [(int(t), int(i), int(rm[t, i, s]))
+                for t, i, s in zip(*np.nonzero(rm != -1))
+                if rm[t, i, s] != failed or t <= plan.fail_time]
+    assert not false_rm, false_rm[:10]
+    # Views stay stable after warm convergence (no churn from misdelivery).
+    joins = np.asarray(ev.join_ids)
+    assert (joins[80:plan.fail_time] == -1).all()
