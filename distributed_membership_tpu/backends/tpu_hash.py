@@ -136,8 +136,18 @@ class HashConfig:
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
-    """The per-node slot map h_node(member)."""
-    return jax.lax.rem(member + node * STRIDE, cfg.s)
+    """The per-node slot map h_node(member) = (member + node*STRIDE) mod S.
+
+    Computed modularly: the naive ``member + node * STRIDE`` overflows
+    int32 for node ids above ~271k (2^31 / STRIDE), yielding negative
+    slots — which silently corrupted self-slot protection and scatter
+    addresses at N > 271k.  ``node % S`` first keeps every intermediate
+    below S^2.  Callers must mask invalid (EMPTY-member) messages
+    themselves — _scatter_msgs' msg_valid/sentinel-address path — the
+    slot value for EMPTY is meaningless, not reliably out of range."""
+    return jax.lax.rem(
+        jax.lax.rem(member, cfg.s) + jax.lax.rem(node, cfg.s) * (STRIDE % cfg.s),
+        cfg.s)
 
 
 def pack(cfg: HashConfig, hb: jax.Array, member: jax.Array) -> jax.Array:
@@ -469,16 +479,20 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # Column alignment: receiver slot = sender slot +
                 # delta*STRIDE with delta = r for unwrapped receiver rows
                 # (j >= r) and r - N for wrapped ones (j < r) — two rolls
-                # selected per row.  (They coincide iff N*STRIDE % S == 0;
-                # relying on that silently corrupts delivery for N not a
-                # multiple of S.)
+                # selected per row.  They coincide iff N*STRIDE % S == 0
+                # — statically true whenever S divides N (the usual scale
+                # config), saving a full [N, S] pass per shift.
                 s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
-                s2 = jax.lax.rem(
-                    jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s)
                 r1 = jnp.roll(rolled, s1, axis=1)
-                r2 = jnp.roll(rolled, s2, axis=1)
-                mail = jnp.maximum(mail, jnp.where((idx >= r)[:, None],
-                                                   r1, r2))
+                if (n * STRIDE) % s == 0:
+                    delivered = r1
+                else:
+                    s2 = jax.lax.rem(
+                        jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride,
+                        s)
+                    r2 = jnp.roll(rolled, s2, axis=1)
+                    delivered = jnp.where((idx >= r)[:, None], r1, r2)
+                mail = jnp.maximum(mail, delivered)
                 cnt = m.sum(1, dtype=I32)
                 sent_gossip = sent_gossip + cnt
                 recv_add = recv_add + jnp.roll(cnt, r)

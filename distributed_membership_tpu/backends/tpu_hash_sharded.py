@@ -347,13 +347,20 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
             cnt_r = jnp.roll(cnt_r, c, axis=0)
             # Column alignment: receiver slot = sender slot + delta*STRIDE,
             # delta = b'*L + c' with b' = b - D on block wrap (receiving
-            # shards me < b) and c' = c - L on row wrap (rows jd < c).
+            # shards me < b, exact via bp) and c' = c - L on row wrap
+            # (rows jd < c).  The row-wrap shifts coincide iff
+            # L*STRIDE % S == 0 — statically true when S divides L (the
+            # usual scale config) — saving one [L, S] pass per shift.
             bp = jnp.where(me < b, b - n_shards, b)
             base1 = lax.rem(lax.rem(bp * n_local + c, s) + s, s)
-            base2 = lax.rem(lax.rem(bp * n_local + c - n_local, s) + s, s)
             r1 = jnp.roll(payload_r, lax.rem(base1 * cstride, s), axis=1)
-            r2 = jnp.roll(payload_r, lax.rem(base2 * cstride, s), axis=1)
-            result = jnp.where((l_idx >= c)[:, None], r1, r2)
+            if (n_local * STRIDE) % s == 0:
+                result = r1
+            else:
+                base2 = lax.rem(
+                    lax.rem(bp * n_local + c - n_local, s) + s, s)
+                r2 = jnp.roll(payload_r, lax.rem(base2 * cstride, s), axis=1)
+                result = jnp.where((l_idx >= c)[:, None], r1, r2)
             mail = jnp.maximum(mail, result)
             recv_add = recv_add + cnt_r
         sent_tick = sent_gossip

@@ -35,6 +35,45 @@ def run_conf(conf_path: str, backend: str | None = None,
     return result
 
 
+SCENARIOS = ("singlefailure", "multifailure", "msgdropsinglefailure")
+
+
+def default_testcases_dir() -> str:
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "testcases")
+
+
+def resolve_platform_if_needed(backend, testdir: str, pin=None):
+    """Pin/probe the jax platform only when a jax backend will run —
+    the pure-host backends must not pay the accelerator probe.
+    Returns the resolved platform name or None when jax is unneeded."""
+    if backend is not None:
+        needs_jax = _backend_needs_jax(backend)
+    else:
+        import os
+        needs_jax = any(
+            _backend_needs_jax(_conf_backend(
+                os.path.join(testdir, f"{s}.conf")))
+            for s in SCENARIOS)
+    if not needs_jax:
+        return None
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    return resolve_platform(pin=pin)
+
+
+def run_scenario_graded(scenario: str, testdir: str, backend, seed,
+                        out_dir: str):
+    """Run one grading scenario and grade its dbg.log; the shared core of
+    grade_all and scripts/package_results.py."""
+    import os
+    result = run_conf(os.path.join(testdir, f"{scenario}.conf"),
+                      backend=backend, seed=seed, out_dir=out_dir)
+    grade = SCENARIO_GRADERS[scenario](result.log.dbg_text(),
+                                       result.params.EN_GPSZ)
+    return result, grade
+
+
 def grade_all(args) -> int:
     """Run the three grading scenarios and print the /90 total — the
     rebuild's equivalent of Grader_verbose.sh's build-run-score loop
@@ -44,21 +83,8 @@ def grade_all(args) -> int:
 
     testdir = args.testcases
     if testdir is None:
-        testdir = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "testcases")
-
-    scenarios = ("singlefailure", "multifailure", "msgdropsinglefailure")
-    if args.backend is not None:
-        needs_jax = _backend_needs_jax(args.backend)
-    else:
-        needs_jax = any(
-            _backend_needs_jax(_conf_backend(
-                os.path.join(testdir, f"{s}.conf")))
-            for s in scenarios)
-    if needs_jax:
-        from distributed_membership_tpu.runtime.platform import (
-            resolve_platform)
-        resolve_platform(pin=args.platform)
+        testdir = default_testcases_dir()
+    resolve_platform_if_needed(args.backend, testdir, pin=args.platform)
 
     total = 0
     print("============================================")
@@ -71,11 +97,8 @@ def grade_all(args) -> int:
         print(title)
         print("============================")
         with tempfile.TemporaryDirectory() as tmp:
-            result = run_conf(os.path.join(testdir, f"{scenario}.conf"),
-                              backend=args.backend, seed=args.seed,
-                              out_dir=tmp)
-        g = SCENARIO_GRADERS[scenario](result.log.dbg_text(),
-                                       result.params.EN_GPSZ)
+            _, g = run_scenario_graded(scenario, testdir, args.backend,
+                                       args.seed, tmp)
         print(f"Checking Join.................."
               f"{g.join_pts}/{g.join_max}")
         print(f"Checking Completeness.........."

@@ -101,3 +101,26 @@ def test_chunk_size_divides():
     for n in (1, 10, 12, 256, 1000, 1024):
         c = _chunk_size(n)
         assert n % c == 0 and 1 <= c <= n
+
+
+def test_slot_of_no_int32_overflow():
+    """Regression: slot_of must equal the exact (member + node*STRIDE) mod S
+    for node ids past the int32 overflow point (~271k with STRIDE=7919).
+    The naive product went negative there, corrupting warm-init placement
+    (a row's own id at a non-self column is never probed -> false
+    removals at N=1M) and scatter addresses."""
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        STRIDE, HashConfig, slot_of)
+
+    cfg = HashConfig(n=1 << 20, s=64, g=16, tfail=16, tremove=40, fanout=3,
+                     drop_prob=0.0, probes=8)
+    nodes = jnp.asarray([0, 1000, 271186, 271188, 1 << 19, (1 << 20) - 1],
+                        jnp.int32)
+    members = jnp.asarray([0, 12345, 99999, 7, (1 << 20) - 1, 3], jnp.int32)
+    got = slot_of(cfg, nodes, members)
+    want = [(int(m) + int(nd) * STRIDE) % cfg.s
+            for nd, m in zip(nodes, members)]
+    assert [int(x) for x in got] == want
+    assert all(0 <= int(x) < cfg.s for x in got)
