@@ -21,8 +21,9 @@ nodes on v4-8.
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Env overrides: BENCH_N / BENCH_TICKS (hash leg), BENCH_DENSE_N,
-BENCH_TIMEOUT (per-leg seconds).
+Env overrides: BENCH_N / BENCH_TICKS / BENCH_VIEW (hash leg; gossip len and
+probes derive from the view size), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg
+seconds).
 """
 
 from __future__ import annotations
@@ -68,7 +69,12 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     from distributed_membership_tpu.config import Params
     from distributed_membership_tpu.runtime.failures import make_plan
 
-    s, g, probes = 128, 32, 16          # probe cycle 8 ticks
+    # Probe cycle = ceil(S/P) = 8 ticks at the defaults.  BENCH_VIEW
+    # selects the regime: S=128 is the detection-quality default, S=16 the
+    # minimum-state / maximum-ticks-per-second point (PERF.md roofline).
+    s = int(os.environ.get("BENCH_VIEW", "128"))
+    g = max(s // 4, 1)
+    probes = max(s // 8, 1)
     params = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from distributed_membership_tpu.backends import RunResult, get_backend
@@ -36,10 +37,11 @@ def run_conf(conf_path: str, backend: str | None = None,
 
 
 SCENARIOS = ("singlefailure", "multifailure", "msgdropsinglefailure")
+SCENARIO_TITLES = ("Single Failure Scenario", "Multi Failure Scenario",
+                   "Message Drop Single Failure Scenario")
 
 
 def default_testcases_dir() -> str:
-    import os
     return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "testcases")
 
@@ -51,7 +53,6 @@ def resolve_platform_if_needed(backend, testdir: str, pin=None):
     if backend is not None:
         needs_jax = _backend_needs_jax(backend)
     else:
-        import os
         needs_jax = any(
             _backend_needs_jax(_conf_backend(
                 os.path.join(testdir, f"{s}.conf")))
@@ -66,7 +67,6 @@ def run_scenario_graded(scenario: str, testdir: str, backend, seed,
                         out_dir: str):
     """Run one grading scenario and grade its dbg.log; the shared core of
     grade_all and scripts/package_results.py."""
-    import os
     result = run_conf(os.path.join(testdir, f"{scenario}.conf"),
                       backend=backend, seed=seed, out_dir=out_dir)
     grade = SCENARIO_GRADERS[scenario](result.log.dbg_text(),
@@ -78,7 +78,6 @@ def grade_all(args) -> int:
     """Run the three grading scenarios and print the /90 total — the
     rebuild's equivalent of Grader_verbose.sh's build-run-score loop
     (Grader_verbose.sh:27-196; 'make' is jit compilation here)."""
-    import os
     import tempfile
 
     testdir = args.testcases
@@ -90,10 +89,7 @@ def grade_all(args) -> int:
     print("============================================")
     print("Grading Started")
     print("============================================")
-    for scenario, title in (("singlefailure", "Single Failure Scenario"),
-                            ("multifailure", "Multi Failure Scenario"),
-                            ("msgdropsinglefailure",
-                             "Message Drop Single Failure Scenario")):
+    for scenario, title in zip(SCENARIOS, SCENARIO_TITLES):
         print(title)
         print("============================")
         with tempfile.TemporaryDirectory() as tmp:

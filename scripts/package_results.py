@@ -77,10 +77,12 @@ def main(argv=None) -> int:
     }
     files["manifest.json"] = json.dumps(manifest, indent=1).encode()
 
+    now = int(time.time())
     with tarfile.open(args.out, "w:gz") as tar:
         for name, data in sorted(files.items()):
             info = tarfile.TarInfo(name)
             info.size = len(data)
+            info.mtime = now
             tar.addfile(info, io.BytesIO(data))
 
     print(json.dumps({"out": args.out, "total_points": total,

@@ -104,12 +104,11 @@ def main() -> int:
         "view_size": args.view, "gossip_len": args.gossip,
         "probes": args.probes, "fanout": args.fanout,
         "tfail": tfail, "tremove": tremove, "seed": args.seed,
-        # EXCHANGE only drives the tpu_hash backend; the sharded backend
-        # uses its bucketed all_to_all, tpu_sparse its sorted mailboxes.
+        # Both hash backends honor EXCHANGE (ring = circulant/torus rolls,
+        # scatter = scatter-max / bucketed all_to_all); tpu_sparse has one
+        # lowering.
         "exchange": (params.resolved_exchange()
-                     if args.backend == "tpu_hash"
-                     else {"tpu_hash_sharded": "bucketed_all_to_all",
-                           "tpu_sparse": "sorted_mailbox"}[args.backend]),
+                     if args.backend != "tpu_sparse" else "sorted_mailbox"),
         "wall_seconds": round(wall, 2),
         "node_ticks_per_sec": round(args.n * args.ticks / wall, 1),
         "verdict_ok": ok,
