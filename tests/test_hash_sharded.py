@@ -121,3 +121,29 @@ def test_ring_wrap_alignment_n_not_multiple_of_s():
     s = result.extra["detection_summary"]
     assert s["false_removals"] == 0, s
     assert s["observer_completeness"] == 1.0, s
+
+
+def test_ring_drop_window_on_mesh():
+    """Sharded ring under a 10% drop window: probe/ack coins (issue-time
+    probe leg, application-time ack leg) plus per-shift gossip masks must
+    keep detection clean — no false removals across shard boundaries.
+
+    Sizing: per-cycle refresh loss is ~1-(1-p)^2 = 0.19; a false removal
+    needs TREMOVE/cycle consecutive losses.  PROBES=16 gives cycle=2,
+    so 15 consecutive losses (~2e-11 per entry) — robust at any seed.
+    TREMOVE=30 with cycle=4 (7.5 losses, ~2e-6 x ~30k entry-windows)
+    measurably false-removes under loss for BOTH exchanges at this N —
+    a protocol-parameter property, not an exchange bug (the reference
+    grader disables its accuracy check in the drop scenario for the same
+    reason, SURVEY.md §4)."""
+    p = Params.from_text(
+        "MAX_NNB: 1024\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+        "DROP_START: 20\nDROP_STOP: 120\nVIEW_SIZE: 32\nGOSSIP_LEN: 8\n"
+        "PROBES: 16\nTFAIL: 10\nTREMOVE: 30\nFANOUT: 3\n"
+        "TOTAL_TIME: 200\nFAIL_TIME: 140\nJOIN_MODE: warm\n"
+        "EVENT_MODE: agg\nEXCHANGE: ring\nBACKEND: tpu_hash_sharded\n")
+    result = get_backend("tpu_hash_sharded")(p, seed=1)
+    s = result.extra["detection_summary"]
+    assert s["false_removals"] == 0, s
+    assert s["observer_completeness"] == 1.0, s
+    assert s["detected_by_someone"] == 1.0, s
