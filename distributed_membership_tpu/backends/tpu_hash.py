@@ -91,6 +91,8 @@ from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     FAST_AGG_MAX_FAILED, AggStats, init_agg, init_fast_agg, update_agg,
     update_fast_agg)
+from distributed_membership_tpu.ops.fused_receive import (
+    fused_supported, receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.runtime.failures import (
@@ -143,6 +145,9 @@ class HashConfig:
     count_probe_io: bool = True  # exact per-node probe/ack recv counters
     #                              (two [N*P]-index histograms per tick);
     #                              off at huge N, totals stay ~exact
+    fused_receive: bool = False  # ring receive via the Pallas one-pass
+    #                              kernel (ops/fused_receive) instead of
+    #                              the jnp expression of the same math
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -185,14 +190,10 @@ def make_admit(n: int, self_slot_mask: jax.Array, row_ids: jax.Array):
     TREMOVE sweep).  ``row_ids`` are the global node ids of the local rows
     (``arange(N)`` single-chip; the shard's row range sharded).
     """
+    from distributed_membership_tpu.ops.fused_receive import _admit
+
     def admit(view: jax.Array, incoming: jax.Array) -> jax.Array:
-        in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
-        occupied = view > 0
-        matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-        ok = jnp.where(self_slot_mask, in_id == row_ids[:, None],
-                       ~occupied | matches)
-        take = (incoming > 0) & ok
-        return jnp.where(take, jnp.maximum(view, incoming), view)
+        return _admit(n, self_slot_mask, row_ids, view, incoming)
 
     return admit
 
@@ -340,63 +341,57 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # contends for still gets its refresh.
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = recv_mask[:, None]
-        prev_id, _, prev_present = unpack(cfg, state.view)
-        admit = make_admit(n, self_slot_mask, idx)
-
-        if ring:
-            view = jnp.where(rcol, admit(state.view, state.mail), state.view)
-        else:
-            view = jnp.where(rcol, admit(state.view, state.amail), state.view)
-            view = jnp.where(rcol, admit(view, state.mail), view)
-        changed = view > state.view
-        view_ts = jnp.where(changed, t, state.view_ts)
-        mail = jnp.where(rcol, 0, state.mail)
-        amail = state.amail if ring else jnp.where(rcol, 0, state.amail)
-
-        cur_id, cur_hb, present = unpack(cfg, view)
-        join_mask = changed & ~prev_present  # admission into an empty slot
-        join_ids = jnp.where(join_mask, cur_id, EMPTY)
-
-        ack_recv_cnt = jnp.zeros((n,), I32)
-        if ring and cfg.probes > 0:
-            # Apply acks for probes issued at t-2 (gather pipeline, see
-            # docstring).  vec[id] = the hb the target acked at t-1
-            # (self_hb at start of t-1, +1 — the mid-increment value the
-            # scatter path's own_hb carries), 0 when it wasn't act.
-            p_cnt = cfg.probes
-            ids2 = state.probe_ids2
-            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-            vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            hb_ack = vec[id2]                              # [N, P] gather
-            valid2 = (ids2 > 0) & (hb_ack > 0) & rcol
-            # Probe-leg drops were already applied at issue time (the probe
-            # block below masks ids_new, exactly as the scatter mode masks
-            # p_valid before scattering — one coin shared by both redundant
-            # copies); only the ack leg's coin applies here.
-            if use_drop:
-                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                valid2 &= ~(jax.random.bernoulli(k_ack2, p_drop, ids2.shape)
-                            & da_ack)
-            cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
-            ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
-            full = jnp.concatenate(
-                [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
-            full = jnp.roll(full, ptr2, axis=1)
-            c_id = ((full - U32(1)) % U32(n)).astype(I32)
-            match = (full > 0) & (view > 0) & (c_id == cur_id)
-            upd = match & (full > view)
-            view = jnp.where(upd, full, view)
-            view_ts = jnp.where(upd, t, view_ts)
-            cur_id, cur_hb, present = unpack(cfg, view)
-            ack_recv_cnt = valid2.sum(1, dtype=I32)
 
         if not ring:
+            prev_id, _, prev_present = unpack(cfg, state.view)
+            admit = make_admit(n, self_slot_mask, idx)
+            view = jnp.where(rcol, admit(state.view, state.amail), state.view)
+            view = jnp.where(rcol, admit(view, state.mail), view)
+            changed = view > state.view
+            view_ts = jnp.where(changed, t, state.view_ts)
+            mail = jnp.where(rcol, 0, state.mail)
+            amail = jnp.where(rcol, 0, state.amail)
+
+            cur_id, cur_hb, present = unpack(cfg, view)
+            join_mask = changed & ~prev_present  # admission into empty slot
+            join_ids = jnp.where(join_mask, cur_id, EMPTY)
+
             # Probe mailbox stores bare prober ids (id + 1, 0 = empty).
             ack_valid = (state.pmail > 0) & recv_mask[:, None]
             ack_tgt = jnp.where(ack_valid, state.pmail.astype(I32) - 1, 0)
             pmail = jnp.where(recv_mask[:, None], 0, state.pmail)
         else:
-            pmail = state.pmail
+            # Ring admit/ack/self/sweep run as ONE fused receive pass
+            # (ops/fused_receive: receive_core, or its Pallas twin when
+            # cfg.fused_receive) — below, after the vector control plane
+            # resolves act/self_on.  Here: ack candidates only.
+            amail, pmail = state.amail, state.pmail
+            ack_recv_cnt = jnp.zeros((n,), I32)
+            cand_full = jnp.zeros((n, s), U32)
+            if cfg.probes > 0:
+                # Acks for probes issued at t-2 (gather pipeline, see
+                # docstring).  vec[id] = the hb the target acked at t-1
+                # (self_hb at start of t-1, +1 — the mid-increment value
+                # the scatter path's own_hb carries), 0 if it wasn't act.
+                p_cnt = cfg.probes
+                ids2 = state.probe_ids2
+                id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+                vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
+                hb_ack = vec[id2]                          # [N, P] gather
+                valid2 = (ids2 > 0) & (hb_ack > 0)
+                # Probe-leg drops applied at issue time (probe block below,
+                # one coin shared by both redundant copies, as in scatter
+                # mode); only the ack leg's coin applies here.
+                if use_drop:
+                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                    valid2 &= ~(jax.random.bernoulli(k_ack2, p_drop,
+                                                     ids2.shape) & da_ack)
+                cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+                ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+                cand_full = jnp.concatenate(
+                    [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
+                cand_full = jnp.roll(cand_full, ptr2, axis=1)
+                ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -421,8 +416,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
 
         joiner_req = start_now & (idx != intro) & ctrl_kept[0]
         joinreq_infl = joinreq_infl | joiner_req
-        mail = _scatter_msgs(cfg, mail, jnp.full((n,), intro, I32), idx,
-                             jnp.zeros((n,), I32), joiner_req)
+        if not ring:
+            mail = _scatter_msgs(cfg, mail, jnp.full((n,), intro, I32), idx,
+                                 jnp.zeros((n,), I32), joiner_req)
         pending_recv = pending_recv.at[intro].add(joiner_req.sum(dtype=I32))
         sent_req = joiner_req.astype(I32)
 
@@ -431,26 +427,45 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         own_hb = state.self_hb + 1
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_on = act | ((idx == intro) & boot)
-        self_slot = slot_of(cfg, idx, idx)
         self_val = pack(cfg, jnp.where(act, own_hb, 0), idx)
-        old_self = view[idx, self_slot]
-        view = view.at[idx, self_slot].set(
-            jnp.where(self_on, self_val, old_self))
-        view_ts = view_ts.at[idx, self_slot].set(
-            jnp.where(self_on, t, view_ts[idx, self_slot]))
-        cur_id, cur_hb, present = unpack(cfg, view)
 
-        # ---- TFAIL / TREMOVE sweep ----
-        difft = t - view_ts
-        stale = present & (difft >= cfg.tfail) & act[:, None]
-        numfailed = stale.sum(1, dtype=I32)
-        removes = stale & (difft >= cfg.tremove)
-        rm_ids = jnp.where(removes, cur_id, EMPTY)
-        view = jnp.where(removes, 0, view)
-        present = present & ~removes
+        if not ring:
+            self_slot = slot_of(cfg, idx, idx)
+            old_self = view[idx, self_slot]
+            view = view.at[idx, self_slot].set(
+                jnp.where(self_on, self_val, old_self))
+            view_ts = view_ts.at[idx, self_slot].set(
+                jnp.where(self_on, t, view_ts[idx, self_slot]))
+            cur_id, cur_hb, present = unpack(cfg, view)
+
+            # ---- TFAIL / TREMOVE sweep ----
+            difft = t - view_ts
+            stale = present & (difft >= cfg.tfail) & act[:, None]
+            numfailed = stale.sum(1, dtype=I32)
+            removes = stale & (difft >= cfg.tremove)
+            rm_ids = jnp.where(removes, cur_id, EMPTY)
+            view = jnp.where(removes, 0, view)
+            present = present & ~removes
+            size = present.sum(1, dtype=I32)
+        else:
+            recv_fn = (
+                (lambda *a: receive_fused(
+                    n, s, cfg.tfail, cfg.tremove, STRIDE,
+                    jax.default_backend() != "tpu", *a))
+                if cfg.fused_receive else
+                (lambda *a: receive_core(
+                    n, s, cfg.tfail, cfg.tremove, STRIDE, *a)))
+            (view, view_ts, mail, join_mask, rm_ids, numfailed,
+             size) = recv_fn(t, state.view, state.view_ts, state.mail,
+                             cand_full, recv_mask, act, self_on, self_val,
+                             idx)
+            mail = _scatter_msgs(cfg, mail, jnp.full((n,), intro, I32), idx,
+                                 jnp.zeros((n,), I32), joiner_req)
+            cur_id, cur_hb, present = unpack(cfg, view)
+            join_ids = jnp.where(join_mask, cur_id, EMPTY)
+            difft = t - view_ts
 
         # ---- gossip ----
-        size = present.sum(1, dtype=I32)
         numpotential = size - 1 - numfailed
         fresh = present & (difft < cfg.tfail)
         is_self_slot = cur_id == idx[:, None]
@@ -712,6 +727,13 @@ def make_config(params: Params, collect_events: bool = True,
     # and does F elementwise passes per tick (observability/aggregates.py).
     fast_agg = (not collect_events and exchange == "ring"
                 and len(fail_ids) <= FAST_AGG_MAX_FAILED)
+    fused = bool(params.FUSED_RECEIVE)
+    if fused and exchange != "ring":
+        raise ValueError("FUSED_RECEIVE requires the ring exchange")
+    if fused and not fused_supported(n, s):
+        raise ValueError(
+            f"FUSED_RECEIVE needs VIEW_SIZE % 128 == 0 and N >= 8 "
+            f"(got N={n}, S={s})")
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,
@@ -720,7 +742,8 @@ def make_config(params: Params, collect_events: bool = True,
         collect_events=collect_events, exchange=exchange,
         fail_ids=tuple(fail_ids) if fast_agg else (),
         fast_agg=fast_agg,
-        count_probe_io=n <= (1 << 17))
+        count_probe_io=n <= (1 << 17),
+        fused_receive=fused)
 
 
 _RUNNER_CACHE: dict = {}

@@ -74,6 +74,8 @@ from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     AggStats, FastAgg, init_agg, init_fast_agg, update_agg, update_fast_agg)
+from distributed_membership_tpu.ops.fused_receive import (
+    receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
@@ -241,76 +243,62 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
         key_l = jax.random.fold_in(key, me)
         k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(key_l, 4)
         k_shifts = jax.random.fold_in(key, 0x517F)     # replicated stream
-        self_slot = slot_of(cfg, lrows, lrows)
-        self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == self_slot[:, None]
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
-        # ---- receive ----
+        # ---- receive: admit + ack + self + sweep as one fused pass ----
+        # (ops/fused_receive: receive_core, or its Pallas twin when
+        # cfg.fused_receive — identical math, tpu_hash.make_step ring.)
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = recv_mask[:, None]
-        prev_present = state.view > 0
 
-        admit = make_admit(n, self_slot_mask, lrows)
-        view = jnp.where(rcol, admit(state.view, state.mail), state.view)
-        changed = view > state.view
-        view_ts = jnp.where(changed, t, state.view_ts)
-        mail = jnp.where(rcol, 0, state.mail)
-        cur_id, cur_hb, present = unpack(cfg, view)
-        join_mask = changed & ~prev_present
-        join_ids = jnp.where(join_mask, cur_id, EMPTY)
-
-        # ---- ack application (probes issued at t-2; tpu_hash pipeline) ----
         ack_recv_cnt = jnp.zeros((n_local,), I32)
+        cand_full = jnp.zeros((n_local, s), U32)
         if cfg.probes > 0:
+            # Ack candidates for probes issued at t-2 (gather pipeline):
+            # one [N] all_gather of the lagged heartbeat vector is the
+            # whole cross-shard probe subsystem.
             vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
             vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)     # [N]
             ids2 = state.probe_ids2
             id2 = jnp.clip(ids2.astype(I32) - 1, 0)
             hb_ack = vec_g[id2]
-            valid2 = (ids2 > 0) & (hb_ack > 0) & rcol
+            valid2 = (ids2 > 0) & (hb_ack > 0)
             if use_drop:
                 da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
                 valid2 &= ~(jax.random.bernoulli(
                     k_ack2, cfg.drop_prob, ids2.shape) & da_ack)
             cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
             ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
-            full = jnp.concatenate(
+            cand_full = jnp.concatenate(
                 [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
-            full = jnp.roll(full, ptr2, axis=1)
-            c_id = ((full - U32(1)) % U32(n)).astype(I32)
-            match = (full > 0) & (view > 0) & (c_id == cur_id)
-            upd = match & (full > view)
-            view = jnp.where(upd, full, view)
-            view_ts = jnp.where(upd, t, view_ts)
-            cur_id, cur_hb, present = unpack(cfg, view)
-            ack_recv_cnt = valid2.sum(1, dtype=I32)
+            cand_full = jnp.roll(cand_full, ptr2, axis=1)
+            ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
 
-        # ---- self refresh ----
+        # ---- self refresh vectors ----
         act = (state.started & (t > start_ticks_l) & ~state.failed
                & state.in_group)
         own_hb = state.self_hb + 1
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
-        old_self = view[l_idx, self_slot]
-        view = view.at[l_idx, self_slot].set(
-            jnp.where(act, pack(cfg, own_hb, lrows), old_self))
-        view_ts = view_ts.at[l_idx, self_slot].set(
-            jnp.where(act, t, view_ts[l_idx, self_slot]))
-        cur_id, cur_hb, present = unpack(cfg, view)
+        self_val = pack(cfg, jnp.where(act, own_hb, 0), lrows)
 
-        # ---- TFAIL / TREMOVE sweep ----
+        recv_fn = (
+            (lambda *a: receive_fused(
+                n, s, cfg.tfail, cfg.tremove, STRIDE,
+                jax.default_backend() != "tpu", *a))
+            if cfg.fused_receive else
+            (lambda *a: receive_core(
+                n, s, cfg.tfail, cfg.tremove, STRIDE, *a)))
+        (view, view_ts, mail, join_mask, rm_ids, numfailed,
+         size) = recv_fn(t, state.view, state.view_ts, state.mail,
+                         cand_full, recv_mask, act, act, self_val, lrows)
+        cur_id, cur_hb, present = unpack(cfg, view)
+        join_ids = jnp.where(join_mask, cur_id, EMPTY)
         difft = t - view_ts
-        stale = present & (difft >= cfg.tfail) & act[:, None]
-        numfailed = stale.sum(1, dtype=I32)
-        removes = stale & (difft >= cfg.tremove)
-        rm_ids = jnp.where(removes, cur_id, EMPTY)
-        view = jnp.where(removes, 0, view)
-        present = present & ~removes
 
         # ---- gossip: torus-product circulant shifts ----
-        size = present.sum(1, dtype=I32)
         numpotential = size - 1 - numfailed
         fresh = present & (difft < cfg.tfail)
         is_self_slot = cur_id == lrows[:, None]
@@ -845,6 +833,16 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
         # warm mode); EXCHANGE auto never selects this combination.
         raise ValueError("EXCHANGE ring on tpu_hash_sharded requires "
                          "JOIN_MODE warm")
+    if cfg.fused_receive:
+        # make_config validated against global N; the kernel runs over the
+        # LOCAL rows here.
+        from distributed_membership_tpu.ops.fused_receive import (
+            fused_supported)
+        if not fused_supported(n_local, cfg.s):
+            raise ValueError(
+                f"FUSED_RECEIVE on tpu_hash_sharded needs the per-shard row "
+                f"count to support the kernel tiling (got L={n_local}, "
+                f"S={cfg.s}; need S % 128 == 0 and L >= 8)")
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"

@@ -103,6 +103,10 @@ class Params:
     # backends/tpu_hash.py make_step), 'auto' picks ring for warm-join
     # bounded-view scale runs and scatter otherwise.
     EXCHANGE: str = "auto"
+    # Run the ring receive pass as one Pallas kernel (ops/fused_receive)
+    # instead of the fused-by-XLA jnp expression.  Requires EXCHANGE ring
+    # and VIEW_SIZE % 128 == 0; interpret-mode fallback off-TPU.
+    FUSED_RECEIVE: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""

@@ -1,0 +1,198 @@
+"""Fused receive pass: admit + ack-apply + self-refresh + TFAIL/TREMOVE
+sweep in one traversal of the ``[N, S]`` state.
+
+After the ring-exchange redesign the hash backend's per-tick cost is pure
+HBM streaming (PERF.md roofline): XLA fuses elementwise chains well, but
+the receive path still spans several producer/consumer groups (admit
+combine, ack candidate compare, self-slot row update, sweep reductions)
+that lower to ~12 passes over the resident state.  This module provides:
+
+* :func:`receive_core` — the pure-jnp reference.  `tpu_hash.make_step`
+  calls it directly (it IS the ring receive path), so the semantics are
+  single-sourced;
+* :func:`receive_fused` — the same computation as ONE Pallas kernel
+  (grid over row blocks, whole-row lanes): each state element is read
+  once and written once, ~6 passes instead of ~12.
+
+The fused path is opt-in (``FUSED_RECEIVE: 1`` conf key): it requires
+``S % 128 == 0`` (lane tiling) and ``N`` divisible by the row-block, and
+is validated bit-exactly against :func:`receive_core` in interpret mode
+(tests/test_fused_receive.py) — the TPU lowering reuses the identical
+kernel body.
+
+Reference semantics preserved exactly (see tpu_hash.make_step): sticky
+admission (make_admit), strict-increase ack refresh with occupant match,
+the double-heartbeat self refresh (MP1Node.cpp:412-415), and the
+TFAIL/TREMOVE sweep (MP1Node.cpp:429-446).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+EMPTY = -1
+
+
+def _admit(n: int, self_mask, row_ids, view, incoming):
+    """Sticky admit-or-refresh (tpu_hash.make_admit, inlined so the same
+    expression serves both the jnp path and the Pallas kernel body)."""
+    in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
+    occupied = view > 0
+    matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+    ok = jnp.where(self_mask, in_id == row_ids[:, None],
+                   ~occupied | matches)
+    take = (incoming > 0) & ok
+    return jnp.where(take, jnp.maximum(view, incoming), view)
+
+
+def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
+                  t, view, view_ts, mail, cand, recv_mask, act,
+                  self_on, self_pack, row_ids):
+    """The shared computation (jnp ops only — legal in both contexts).
+
+    Returns (view, view_ts, mail_cleared, join_mask, rm_ids,
+    numfailed, size).
+    """
+    rcol = recv_mask[:, None]
+    col = jax.lax.broadcasted_iota(I32, view.shape, 1)
+    # slot_of(i, i) = i*(1+STRIDE) mod S, computed modularly (the overflow
+    # guard of tpu_hash.slot_of).
+    self_slot = jax.lax.rem(
+        jax.lax.rem(row_ids, s) * ((1 + stride) % s), s)
+    self_mask = col == self_slot[:, None]
+
+    prev_present = view > 0
+    # --- admit gossip mail (sticky admission) ---
+    admitted = _admit(n, self_mask, row_ids, view, mail)
+    new_view = jnp.where(rcol, admitted, view)
+    changed = new_view > view
+    new_ts = jnp.where(changed, t, view_ts)
+    join_mask = changed & ~prev_present
+    mail_cleared = jnp.where(rcol, U32(0), mail)
+
+    # --- ack application: occupant-matched strict-increase refresh ---
+    c_id = ((cand - U32(1)) % U32(n)).astype(I32)
+    v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
+    match = (cand > 0) & (new_view > 0) & (c_id == v_id) & rcol
+    upd = match & (cand > new_view)
+    new_view = jnp.where(upd, cand, new_view)
+    new_ts = jnp.where(upd, t, new_ts)
+
+    # --- self refresh (double heartbeat increment, caller packs) ---
+    s_on = self_mask & self_on[:, None]
+    new_view = jnp.where(s_on, self_pack[:, None], new_view)
+    new_ts = jnp.where(s_on, t, new_ts)
+
+    # --- TFAIL / TREMOVE sweep ---
+    present = new_view > 0
+    difft = t - new_ts
+    stale = present & (difft >= tfail) & act[:, None]
+    numfailed = stale.sum(1, dtype=I32)
+    removes = stale & (difft >= tremove)
+    cur_id = jnp.where(present,
+                       ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
+    rm_ids = jnp.where(removes, cur_id, EMPTY)
+    new_view = jnp.where(removes, U32(0), new_view)
+    size = (new_view > 0).sum(1, dtype=I32)
+
+    return (new_view, new_ts, mail_cleared, join_mask, rm_ids,
+            numfailed, size)
+
+
+def receive_core(n: int, s: int, tfail: int, tremove: int, stride: int,
+                 t, view, view_ts, mail, cand, recv_mask, act,
+                 self_on, self_pack, row_ids):
+    """Pure-jnp receive pass (reference AND default implementation)."""
+    return _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
+                         mail, cand, recv_mask, act, self_on, self_pack,
+                         row_ids)
+
+
+def _pick_block(n: int) -> int:
+    for b in (512, 256, 128, 64, 32, 16, 8):
+        if n % b == 0:
+            return b
+    return n
+
+
+def fused_supported(n: int, s: int) -> bool:
+    """Lane tiling wants whole 128-lane rows; row blocks must divide N."""
+    return s % 128 == 0 and n >= 8
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
+                  interpret: bool,
+                  t, view, view_ts, mail, cand, recv_mask, act,
+                  self_on, self_pack, row_ids):
+    """One-traversal Pallas version of :func:`receive_core`.
+
+    Masks travel as int32 (bool VMEM tiling is dtype-hostile); the kernel
+    body is :func:`_receive_body` itself — jnp ops lower inside Pallas —
+    so the two paths cannot drift.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = view.shape[0]       # == n single-chip; the local row count L
+    #                            when a shard calls with its slice
+    b = _pick_block(rows)
+    grid = (rows // b,)
+
+    def kernel(t_ref, view_ref, ts_ref, mail_ref, cand_ref, recv_ref,
+               act_ref, son_ref, spack_ref, rows_ref,
+               view_out, ts_out, mailc_out, join_out, rm_out,
+               nf_out, size_out):
+        (nv, nts, mc, join, rm, nf, sz) = _receive_body(
+            n, s, tfail, tremove, stride, t_ref[0],
+            view_ref[:], ts_ref[:], mail_ref[:], cand_ref[:],
+            recv_ref[:] != 0, act_ref[:] != 0, son_ref[:] != 0,
+            spack_ref[:], rows_ref[:])
+        view_out[:] = nv
+        ts_out[:] = nts
+        mailc_out[:] = mc
+        join_out[:] = join.astype(I32)
+        rm_out[:] = rm
+        nf_out[:] = nf
+        size_out[:] = sz
+
+    row_spec = pl.BlockSpec((b, s), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((b,), lambda i: (i,),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # t
+            row_spec, row_spec, row_spec, row_spec,  # view, ts, mail, cand
+            vec_spec, vec_spec, vec_spec,            # recv, act, self_on
+            vec_spec, vec_spec,                      # self_pack, row_ids
+        ],
+        out_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
+                   vec_spec, vec_spec],
+        # Donate the big state buffers in place (view->view, ts->ts,
+        # mail->mail_cleared): no duplicate [N, S] allocations live across
+        # the call — the point of an HBM-roofline kernel.  (Input index 0
+        # is the SMEM t scalar, so state inputs start at 1.)
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, s), U32),   # view
+            jax.ShapeDtypeStruct((rows, s), I32),   # view_ts
+            jax.ShapeDtypeStruct((rows, s), U32),   # mail cleared
+            jax.ShapeDtypeStruct((rows, s), I32),   # join mask (i32)
+            jax.ShapeDtypeStruct((rows, s), I32),   # rm ids
+            jax.ShapeDtypeStruct((rows,), I32),     # numfailed
+            jax.ShapeDtypeStruct((rows,), I32),     # size
+        ],
+        interpret=interpret,
+    )(jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
+      recv_mask.astype(I32), act.astype(I32), self_on.astype(I32),
+      self_pack, row_ids)
+    (view2, ts2, mailc, join_i, rm_ids, nf, size) = out
+    return view2, ts2, mailc, join_i != 0, rm_ids, nf, size
