@@ -1,0 +1,110 @@
+"""Per-tick cost profiler for the hash scale path on the current platform.
+
+Times the compiled `tpu_hash` scan (warm cache, fresh seed) across a grid
+of (N, VIEW_SIZE, exchange, fused_receive) points and prints one JSON line
+per point: wall seconds, ticks/s, node-ticks/s, and the implied HBM GB/s
+against the ring roofline estimate (PERF.md).  Used to pick the default
+lowering on real hardware; evidence lands in PERF.md tables.
+
+Usage:
+  python scripts/profile_step.py                      # default grid
+  python scripts/profile_step.py --n 1048576 --view 128 --ticks 30
+  python scripts/profile_step.py --fused both         # compare kernel
+  python scripts/profile_step.py --platform cpu       # pin cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
+               fanout: int = 3) -> dict:
+    import random as _pyrandom
+
+    import jax
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        make_config, run_scan)
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    g = max(s // 4, 1)
+    probes = max(s // 8, 1)
+    params = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
+        f"FANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
+        f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
+        f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
+        f"BACKEND: tpu_hash\n")
+    plan = make_plan(params, _pyrandom.Random("app:0"))
+
+    t0 = time.perf_counter()
+    final_state, _ = run_scan(params, plan, seed=0, collect_events=False,
+                              total_time=ticks)
+    jax.block_until_ready(final_state)
+    compile_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    final_state, _ = run_scan(params, plan, seed=1, collect_events=False,
+                              total_time=ticks)
+    jax.block_until_ready(final_state)
+    wall = time.perf_counter() - t0
+
+    cfg = make_config(params, collect_events=False)
+    # Ring roofline passes (PERF.md): receive ~12 jnp / ~6 fused, gossip
+    # ~3 per shift, probe/agg ~4.
+    state_bytes = 3 * n * s * 4
+    passes = (6 if fused else 12) + 3 * min(cfg.fanout, cfg.s) + 4
+    est_gb_per_tick = passes * (n * s * 4) / 1e9
+    return {
+        "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
+        "fused": fused, "fanout": cfg.fanout, "probes": cfg.probes,
+        "platform": jax.default_backend(),
+        "compile_plus_first_run_s": round(compile_wall, 2),
+        "wall_seconds": round(wall, 3),
+        "ticks_per_sec": round(ticks / wall, 2),
+        "node_ticks_per_sec": round(n * ticks / wall, 1),
+        "ms_per_tick": round(1000 * wall / ticks, 2),
+        "resident_state_mb": round(state_bytes / 1e6, 1),
+        "est_model_gb_per_tick": round(est_gb_per_tick, 3),
+        "implied_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=0,
+                    help="single N (0 = default grid)")
+    ap.add_argument("--view", type=int, default=128)
+    ap.add_argument("--ticks", type=int, default=40)
+    ap.add_argument("--exchange", default="ring",
+                    choices=["ring", "scatter"])
+    ap.add_argument("--fanout", type=int, default=3)
+    ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    resolve_platform(pin=args.platform)
+
+    ns = [args.n] if args.n else [1 << 16, 1 << 18, 1 << 20]
+    fused_opts = {"off": [False], "on": [True],
+                  "both": [False, True]}[args.fused]
+    for n in ns:
+        for fused in fused_opts:
+            rec = time_point(n, args.view, args.ticks, args.exchange,
+                             fused, args.fanout)
+            print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
