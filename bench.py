@@ -186,19 +186,36 @@ def main() -> int:
         print("warning: TPU backend unavailable; benchmarking on cpu",
               file=sys.stderr)
 
-    hash_n = int(os.environ.get("BENCH_N", str(1 << 20 if on_accel
-                                               else 1 << 16)))
-    hash_ticks = int(os.environ.get("BENCH_TICKS",
-                                    "60" if on_accel else "40"))
     dense_n = int(os.environ.get("BENCH_DENSE_N", "8192"))
 
-    hash_res = _run_leg("hash", hash_n, hash_ticks, not on_accel, timeout)
-    if hash_res is None and on_accel:
-        # TPU probe succeeded but the leg died (relay flake / compile
-        # error): fall back to a CPU-sized rerun so a number still lands.
-        hash_res = _run_leg("hash", 1 << 16, 40, True, timeout)
-    dense_res = _run_leg("dense", dense_n, 100, not on_accel, timeout)
-    if dense_res is None and on_accel:
+    if on_accel:
+        # The TPU relay here can serve one run and then WEDGE on the next
+        # (observed: a 65k-node run completed in 33 s, then a 1M-node run
+        # hung >25 min and probes failed from then on).  So climb the size
+        # ladder UPWARD with per-rung timeouts, keeping the largest success
+        # — the cheap rung banks a real TPU number before any bigger
+        # request risks wedging the relay.
+        if "BENCH_N" in os.environ:
+            ladder = [(int(os.environ["BENCH_N"]),
+                       int(os.environ.get("BENCH_TICKS", "60")), timeout)]
+        else:
+            ladder = [(1 << 16, 100, min(timeout, 300.0)),
+                      (1 << 18, 60, min(timeout, 480.0)),
+                      (1 << 20, 60, min(timeout, 900.0))]
+        hash_res = None
+        for n, ticks, rung_timeout in ladder:
+            res = _run_leg("hash", n, ticks, False, rung_timeout)
+            if res is None:
+                break            # relay flaked; keep what already landed
+            hash_res = res
+        if hash_res is None:
+            hash_res = _run_leg("hash", 1 << 16, 40, True, timeout)
+        dense_res = (_run_leg("dense", dense_n, 100, False, timeout)
+                     or _run_leg("dense", dense_n, 100, True, timeout))
+    else:
+        hash_n = int(os.environ.get("BENCH_N", str(1 << 16)))
+        hash_ticks = int(os.environ.get("BENCH_TICKS", "40"))
+        hash_res = _run_leg("hash", hash_n, hash_ticks, True, timeout)
         dense_res = _run_leg("dense", dense_n, 100, True, timeout)
 
     if hash_res is None:

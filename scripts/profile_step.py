@@ -24,8 +24,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _example_scan_args(params, plan, ticks):
+    import jax
+
+    from distributed_membership_tpu.runtime.failures import plan_tensors
+
+    (tick_arr, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, 0, ticks)
+    return (keys, tick_arr, start_ticks, fail_mask, fail_time,
+            drop_lo, drop_hi, jax.random.PRNGKey(0))
+
+
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
-               fanout: int = 3) -> dict:
+               fanout: int = 3, cost: bool = False) -> dict:
     import random as _pyrandom
 
     import jax
@@ -64,6 +75,32 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     state_bytes = 3 * n * s * 4
     passes = (6 if fused else 12) + 3 * min(cfg.fanout, cfg.s) + 4
     est_gb_per_tick = passes * (n * s * 4) / 1e9
+
+    # Objective pass count from the compiled step itself: XLA's cost
+    # analysis reports total bytes accessed; divided by ticks and the
+    # [N, S] u32 plane size it says how many logical full-state passes
+    # the compiler actually scheduled (the number kernel fusion reduces).
+    measured = {}
+    if cost:
+        # Opt-in (--cost): lower().compile() recompiles outside the jit
+        # cache, roughly doubling the rung's wall time.
+        try:
+            from distributed_membership_tpu.backends.tpu_hash import _get_runner
+            runner = _get_runner(cfg, True)   # warm-join runner (jit fn)
+            args = _example_scan_args(params, plan, ticks)
+            analysis = runner.lower(*args).compile().cost_analysis()
+            if analysis:
+                ba = float(analysis.get("bytes accessed", 0.0))
+                measured = {
+                    "xla_bytes_accessed_per_tick_gb":
+                        round(ba / ticks / 1e9, 3),
+                    "xla_passes_per_tick":
+                        round(ba / ticks / (n * s * 4), 1),
+                    "xla_flops_per_tick":
+                        float(analysis.get("flops", 0.0)) / ticks,
+                }
+        except Exception as e:   # best-effort diagnostics
+            measured = {"cost_analysis_error": repr(e)[:120]}
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fanout": cfg.fanout, "probes": cfg.probes,
@@ -76,6 +113,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         "resident_state_mb": round(state_bytes / 1e6, 1),
         "est_model_gb_per_tick": round(est_gb_per_tick, 3),
         "implied_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
+        **measured,
     }
 
 
@@ -89,6 +127,9 @@ def main() -> int:
                     choices=["ring", "scatter"])
     ap.add_argument("--fanout", type=int, default=3)
     ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
+    ap.add_argument("--cost", action="store_true",
+                    help="add XLA cost-analysis fields (recompiles: ~2x "
+                         "rung wall time)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -101,7 +142,7 @@ def main() -> int:
     for n in ns:
         for fused in fused_opts:
             rec = time_point(n, args.view, args.ticks, args.exchange,
-                             fused, args.fanout)
+                             fused, args.fanout, cost=args.cost)
             print(json.dumps(rec), flush=True)
     return 0
 

@@ -1,0 +1,82 @@
+"""Real-chip correctness check: Pallas fused receive vs the jnp reference.
+
+The fused kernel (ops/fused_receive.py) is pinned bit-exactly against
+`receive_core` in interpret mode on CPU (tests/test_fused_receive.py); this
+script closes the remaining gap — the actual Mosaic TPU lowering — by
+running the full `tpu_hash` scan twice on the real chip (FUSED_RECEIVE
+off/on, same seed) and comparing final states and detection summaries
+bit-for-bit.  Exit 0 = identical; also re-checks the jnp path against CPU
+for cross-platform drift (informational: XLA may legitimately differ
+across platforms in RNG-free reductions; the fused-vs-jnp SAME-platform
+check is the hard gate).
+
+Run it whenever the relay is up:  python scripts/tpu_correctness.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_once(fused: bool, n: int = 8192, s: int = 128, ticks: int = 60):
+    import random as _pyrandom
+
+    import numpy as np
+
+    from distributed_membership_tpu.backends.tpu_hash import run_scan
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    params = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+        f"DROP_START: 10\nDROP_STOP: {ticks - 10}\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 4}\nPROBES: {s // 8}\n"
+        f"FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: {ticks}\n"
+        f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+        f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused)}\n"
+        f"BACKEND: tpu_hash\n")
+    plan = make_plan(params, _pyrandom.Random("app:0"))
+    final_state, _ = run_scan(params, plan, seed=0, collect_events=False)
+    # Compare the ENTIRE final state pytree (view, timestamps, mailboxes,
+    # scalars, and whichever aggregate struct the config selected).
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(final_state)
+    return {jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in leaves}
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=8192)
+    ap.add_argument("--ticks", type=int, default=60)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    platform = resolve_platform(pin=args.platform)
+
+    import jax
+
+    backend = jax.default_backend()
+    print(f"platform={platform} backend={backend}", flush=True)
+
+    base = run_once(fused=False, n=args.n, ticks=args.ticks)
+    fused = run_once(fused=True, n=args.n, ticks=args.ticks)
+    diffs = {k: int((base[k] != fused[k]).sum()) for k in base}
+    ok = all(v == 0 for v in diffs.values())
+    print(json.dumps({"check": "fused_vs_jnp_same_platform",
+                      "platform": backend, "ok": ok,
+                      "mismatched_elements": diffs}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

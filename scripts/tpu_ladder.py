@@ -36,8 +36,12 @@ sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 
 # (name, n, view, ticks, fused, timeout_s) — smallest first; timeouts sized
-# ~4x the expected wall so a hung relay is cut quickly.
+# ~4x the expected wall so a hung relay is cut quickly.  The special first
+# rung runs scripts/tpu_correctness.py (fused-vs-jnp bit-equality on the
+# real Mosaic lowering) instead of a timing point.
+CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, False, 420)
 LADDER = [
+    CORRECTNESS_RUNG,
     ("65k_s64",        1 << 16,  64, 150, False, 240),
     ("65k_s128",       1 << 16, 128, 100, False, 300),
     ("65k_s128_fused", 1 << 16, 128, 100, True,  300),
@@ -85,9 +89,15 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: bool,
              timeout: float) -> dict | None:
     env = dict(os.environ)
     env["DM_RESOLVED_PLATFORM"] = "tpu"   # probe said yes; don't re-probe
-    cmd = [sys.executable, os.path.join(REPO, "scripts", "profile_step.py"),
-           "--n", str(n), "--view", str(s), "--ticks", str(ticks),
-           "--fused", "on" if fused else "off"]
+    if name == CORRECTNESS_RUNG[0]:
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "tpu_correctness.py"),
+               "--n", str(n), "--ticks", str(ticks)]
+    else:
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "profile_step.py"),
+               "--n", str(n), "--view", str(s), "--ticks", str(ticks),
+               "--fused", "on" if fused else "off"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True, env=env, cwd=REPO)
@@ -141,8 +151,11 @@ def one_pass() -> tuple[int, int]:
             break
         append(rec)
         landed += 1
-        print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} node-ticks/s "
-              f"({rec['ms_per_tick']} ms/tick)", flush=True)
+        if "node_ticks_per_sec" in rec:
+            print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
+                  f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)
+        else:
+            print(f"  rung {name}: {json.dumps(rec)}", flush=True)
     return landed, len(_missing())
 
 
