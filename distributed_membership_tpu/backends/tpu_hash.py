@@ -770,11 +770,19 @@ def _get_runner(cfg: HashConfig, warm: bool):
     return _RUNNER_CACHE[cache_key]
 
 
+def plan_fail_ids(plan: FailurePlan) -> tuple:
+    """The static failed-id list make_config needs for the FastAgg path.
+
+    Single-sourced so external profilers (scripts/profile_step.py --cost)
+    construct EXACTLY the config run_scan runs — a drifted copy once made
+    the analyzed program differ from the timed one (ADVICE r2)."""
+    return tuple(plan.failed_indices) if plan.fail_time is not None else ()
+
+
 def run_scan(params: Params, plan: FailurePlan, seed: int,
              collect_events: bool = True, total_time: Optional[int] = None):
     """Run the full simulation; returns (final_state, events)."""
-    fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
-    cfg = make_config(params, collect_events, fail_ids=fail_ids)
+    cfg = make_config(params, collect_events, fail_ids=plan_fail_ids(plan))
     total = total_time if total_time is not None else params.TOTAL_TIME
     # Same effective-run-length packing guard as tpu_sparse.run_scan.
     params.validate_sparse_packing(total)

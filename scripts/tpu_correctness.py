@@ -5,10 +5,8 @@ The fused kernel (ops/fused_receive.py) is pinned bit-exactly against
 script closes the remaining gap — the actual Mosaic TPU lowering — by
 running the full `tpu_hash` scan twice on the real chip (FUSED_RECEIVE
 off/on, same seed) and comparing final states and detection summaries
-bit-for-bit.  Exit 0 = identical; also re-checks the jnp path against CPU
-for cross-platform drift (informational: XLA may legitimately differ
-across platforms in RNG-free reductions; the fused-vs-jnp SAME-platform
-check is the hard gate).
+bit-for-bit.  Exit 0 = identical.  The comparison is same-platform only:
+fused-vs-jnp on whatever backend resolve_platform selects.
 
 Run it whenever the relay is up:  python scripts/tpu_correctness.py
 """

@@ -106,6 +106,23 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: bool,
               flush=True)
         return None
     if r.returncode != 0:
+        if name == CORRECTNESS_RUNG[0]:
+            # A deterministic fused-vs-jnp mismatch is EVIDENCE, not a relay
+            # flake: tpu_correctness.py exits 1 with the mismatch JSON on
+            # stdout.  Record it (so --loop doesn't retry forever) and let
+            # _missing() drop the fused rungs.
+            try:
+                rec = json.loads(r.stdout.strip().splitlines()[-1])
+                if rec.get("check") == "fused_vs_jnp_same_platform":
+                    print(f"  rung {name}: CORRECTNESS FAILURE — "
+                          f"{json.dumps(rec['mismatched_elements'])}",
+                          flush=True)
+                    rec["rung"] = name
+                    rec["timestamp"] = time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                    return rec
+            except (json.JSONDecodeError, IndexError):
+                pass
         tail = (r.stderr or "").strip().splitlines()[-4:]
         print(f"  rung {name}: rc={r.returncode}\n    " + "\n    ".join(tail),
               flush=True)
@@ -121,8 +138,14 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: bool,
 
 def _missing() -> list:
     done = load_done()
+    # A recorded correctness FAILURE gates the fused timing rungs off: a
+    # kernel that miscompiles on Mosaic must not contribute perf evidence.
+    corr = done.get(CORRECTNESS_RUNG[0])
+    fused_ok = corr is None or corr.get("ok", False)
     return [r for r in LADDER
-            if r[0] not in done and not (r[4] and r[2] % 128 != 0)]
+            if r[0] not in done
+            and not (r[4] and r[2] % 128 != 0)
+            and not (r[4] and not fused_ok)]
 
 
 def one_pass() -> tuple[int, int]:
@@ -136,7 +159,9 @@ def one_pass() -> tuple[int, int]:
               flush=True)
         return 0, len(missing)
     landed = 0
-    for name, n, s, ticks, fused, timeout in missing:
+    pending = list(missing)
+    while pending:
+        name, n, s, ticks, fused, timeout = pending.pop(0)
         print(f"rung {name}: n={n} s={s} ticks={ticks} fused={fused}",
               flush=True)
         rec = run_rung(name, n, s, ticks, fused, timeout)
@@ -151,6 +176,10 @@ def one_pass() -> tuple[int, int]:
             break
         append(rec)
         landed += 1
+        if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
+            # Gate fused timing rungs off THIS pass too, not just the next
+            # (_missing() only sees the failure on re-read).
+            pending = [r for r in pending if not r[4]]
         if "node_ticks_per_sec" in rec:
             print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
                   f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)
