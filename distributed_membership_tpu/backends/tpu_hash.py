@@ -91,6 +91,8 @@ from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     FAST_AGG_MAX_FAILED, AggStats, init_agg, init_fast_agg, update_agg,
     update_fast_agg)
+from distributed_membership_tpu.ops.fused_gossip import (
+    gossip_fused, gossip_fused_supported)
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
@@ -102,6 +104,22 @@ I32 = jnp.int32
 U32 = jnp.uint32
 STRIDE = 7919  # odd prime: per-node slot-map offset decorrelates which id
 #                pairs collide across different nodes' views
+# Above this node count the ring mode stops building the two full-width
+# [N*P]-index histograms that attribute probe recv / ack sends to their
+# true rows; totals stay exact, the per-node split becomes approximate
+# (attributed to the prober's row).  Summaries carry an
+# ``approx_probe_attribution`` flag derived from this same constant so the
+# degradation is visible in the output, not just in PERF.md (VERDICT r2
+# weak-6/item-8).
+PROBE_IO_EXACT_MAX = 1 << 17
+
+
+def probe_attribution_exact(params: Params) -> bool:
+    """Whether per-node probe/ack recv counters are exactly attributed
+    (see PROBE_IO_EXACT_MAX; scatter mode and probe-free configs always
+    are)."""
+    return (params.resolved_exchange() != "ring" or params.PROBES <= 0
+            or params.EN_GPSZ <= PROBE_IO_EXACT_MAX)
 
 
 class HashState(NamedTuple):
@@ -148,6 +166,9 @@ class HashConfig:
     fused_receive: bool = False  # ring receive via the Pallas one-pass
     #                              kernel (ops/fused_receive) instead of
     #                              the jnp expression of the same math
+    fused_gossip: bool = False   # all circulant shifts delivered in one
+    #                              Pallas traversal (ops/fused_gossip)
+    #                              instead of fanout roll+max passes
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -317,6 +338,16 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
     if ring and cfg.probes >= s:
         raise ValueError("ring mode needs PROBES < VIEW_SIZE "
                          f"(got {cfg.probes} >= {s})")
+    if cfg.fused_gossip and (dynamic_knobs or cfg.drop_prob > 0
+                             or not gossip_fused_supported(n, s)):
+        # Drops draw a per-shift [N, S] mask the kernel cannot replicate
+        # bit-exactly, and unsupported shapes need the two-roll wrapped-row
+        # column alignment the kernel omits (make_config rejects both too;
+        # this guards direct make_step callers like the sweep driver).
+        raise ValueError(
+            "FUSED_GOSSIP requires a static drop-free config and "
+            f"supported shapes (got N={n}, S={s}, "
+            f"dynamic_knobs={dynamic_knobs}, drop={cfg.drop_prob})")
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
     use_drop = dynamic_knobs or cfg.drop_prob > 0.0
@@ -492,35 +523,53 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             cstride = STRIDE % s
             sent_gossip = jnp.zeros((n,), I32)
             recv_add = jnp.zeros((n,), I32)
-            for j in range(k_max):
-                m = keep & (j < k_eff)[:, None]
-                if use_drop:
-                    m = m & ~(jax.random.bernoulli(
-                        jax.random.fold_in(k_drop, j), p_drop, (n, s))
-                        & drop_active)
-                r = shifts[j]
-                payload = jnp.where(m, view, U32(0))
-                rolled = jnp.roll(payload, r, axis=0)
-                # Column alignment: receiver slot = sender slot +
-                # delta*STRIDE with delta = r for unwrapped receiver rows
-                # (j >= r) and r - N for wrapped ones (j < r) — two rolls
-                # selected per row.  They coincide iff N*STRIDE % S == 0
-                # — statically true whenever S divides N (the usual scale
-                # config), saving a full [N, S] pass per shift.
-                s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
-                r1 = jnp.roll(rolled, s1, axis=1)
-                if (n * STRIDE) % s == 0:
-                    delivered = r1
-                else:
-                    s2 = jax.lax.rem(
-                        jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride,
-                        s)
-                    r2 = jnp.roll(rolled, s2, axis=1)
-                    delivered = jnp.where((idx >= r)[:, None], r1, r2)
-                mail = jnp.maximum(mail, delivered)
-                cnt = m.sum(1, dtype=I32)
-                sent_gossip = sent_gossip + cnt
-                recv_add = recv_add + jnp.roll(cnt, r)
+            if cfg.fused_gossip and not use_drop and k_max > 0:
+                # One Pallas traversal for all shifts (ops/fused_gossip):
+                # mail is read+written once; sender rows arrive by
+                # scalar-prefetch block indexing.  Counters reduce to a
+                # per-row nonzero count times the clipped fanout — payload
+                # is nonzero exactly where keep holds (kept slots are
+                # present, and packed entries are > 0).
+                payload = jnp.where(keep, view, U32(0))
+                mail = gossip_fused(
+                    n, s, k_max, jax.default_backend() != "tpu",
+                    mail, payload, k_eff, shifts)
+                c0 = keep.sum(1, dtype=I32)
+                for j in range(k_max):
+                    cnt = jnp.where(j < k_eff, c0, 0)
+                    sent_gossip = sent_gossip + cnt
+                    recv_add = recv_add + jnp.roll(cnt, shifts[j])
+            else:
+                for j in range(k_max):
+                    m = keep & (j < k_eff)[:, None]
+                    if use_drop:
+                        m = m & ~(jax.random.bernoulli(
+                            jax.random.fold_in(k_drop, j), p_drop, (n, s))
+                            & drop_active)
+                    r = shifts[j]
+                    payload = jnp.where(m, view, U32(0))
+                    rolled = jnp.roll(payload, r, axis=0)
+                    # Column alignment: receiver slot = sender slot +
+                    # delta*STRIDE with delta = r for unwrapped receiver
+                    # rows (j >= r) and r - N for wrapped ones (j < r) —
+                    # two rolls selected per row.  They coincide iff
+                    # N*STRIDE % S == 0 — statically true whenever S
+                    # divides N (the usual scale config), saving a full
+                    # [N, S] pass per shift.
+                    s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+                    r1 = jnp.roll(rolled, s1, axis=1)
+                    if (n * STRIDE) % s == 0:
+                        delivered = r1
+                    else:
+                        s2 = jax.lax.rem(
+                            jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
+                            * cstride, s)
+                        r2 = jnp.roll(rolled, s2, axis=1)
+                        delivered = jnp.where((idx >= r)[:, None], r1, r2)
+                    mail = jnp.maximum(mail, delivered)
+                    cnt = m.sum(1, dtype=I32)
+                    sent_gossip = sent_gossip + cnt
+                    recv_add = recv_add + jnp.roll(cnt, r)
             sent_tick = sent_gossip + sent_req + sent_rep
             k_drop_s = k_drop
         else:
@@ -734,6 +783,18 @@ def make_config(params: Params, collect_events: bool = True,
         raise ValueError(
             f"FUSED_RECEIVE needs VIEW_SIZE % 128 == 0 and N >= 8 "
             f"(got N={n}, S={s})")
+    fused_g = bool(params.FUSED_GOSSIP)
+    if fused_g and exchange != "ring":
+        raise ValueError("FUSED_GOSSIP requires the ring exchange")
+    if fused_g and not gossip_fused_supported(n, s):
+        raise ValueError(
+            f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
+            f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s})")
+    if fused_g and params.effective_drop_prob() > 0:
+        raise ValueError(
+            "FUSED_GOSSIP requires a drop-free config (the jnp path "
+            "draws a fresh per-shift drop mask the kernel cannot "
+            "replicate bit-exactly)")
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,
@@ -742,8 +803,8 @@ def make_config(params: Params, collect_events: bool = True,
         collect_events=collect_events, exchange=exchange,
         fail_ids=tuple(fail_ids) if fast_agg else (),
         fast_agg=fast_agg,
-        count_probe_io=n <= (1 << 17),
-        fused_receive=fused)
+        count_probe_io=n <= PROBE_IO_EXACT_MAX,
+        fused_receive=fused, fused_gossip=fused_g)
 
 
 _RUNNER_CACHE: dict = {}

@@ -558,6 +558,15 @@ def finish_run(params: Params, plan: FailurePlan, log: EventLog,
         fail_mask[failed] = True
         summary = detection_summary(final_state.agg, fail_mask,
                                     plan.fail_time)
+        if params.BACKEND.startswith("tpu_hash"):
+            # Mark when per-node probe recv/ack-send counters are
+            # attributed to the prober's row rather than the true nodes
+            # (tpu_hash.probe_attribution_exact) so no summary needs a
+            # PERF.md footnote to be read correctly.
+            from distributed_membership_tpu.backends.tpu_hash import (
+                probe_attribution_exact)
+            summary["approx_probe_attribution"] = (
+                not probe_attribution_exact(params))
         # Per-node totals only (the [N, T] matrix is the thing that cannot
         # exist at scale); write_msgcount is skipped by the driver.
         sent = np.asarray(final_state.agg.sent_total)[:, None]

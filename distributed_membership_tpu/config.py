@@ -107,6 +107,11 @@ class Params:
     # instead of the fused-by-XLA jnp expression.  Requires EXCHANGE ring
     # and VIEW_SIZE % 128 == 0; interpret-mode fallback off-TPU.
     FUSED_RECEIVE: int = 0
+    # Deliver all circulant gossip shifts in one Pallas traversal
+    # (ops/fused_gossip) instead of fanout separate roll+max passes.
+    # Requires EXCHANGE ring, VIEW_SIZE % 128 == 0, N a multiple of the
+    # view size ((N*STRIDE) % S == 0), and a drop-free config.
+    FUSED_GOSSIP: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -204,6 +209,48 @@ class Params:
                     f"(cycle = ceil(VIEW_SIZE/PROBES) = {cycle} ticks): "
                     "too few refresh chances per removal window; raise "
                     "TREMOVE or PROBES")
+            k_min = self.min_tremove_cycles_under_loss()
+            if k_min and self.TREMOVE < k_min * cycle:
+                # Warning, not an error: the phase sweep intentionally maps
+                # the false-removal knee below this floor.  Production
+                # configs should heed it (measured: the floor is tight —
+                # see artifacts/LOSS_STRESS.json).
+                import warnings
+                warnings.warn(
+                    f"TREMOVE={self.TREMOVE} spans under "
+                    f"{k_min} probe cycles (cycle={cycle}) at drop "
+                    f"probability {self.effective_drop_prob()}: expected "
+                    "false removals > 0 over this run "
+                    "(Params.min_tremove_cycles_under_loss)",
+                    stacklevel=2)
+
+    def min_tremove_cycles_under_loss(self) -> int:
+        """Smallest TREMOVE-in-probe-cycles making expected false removals
+        < 1 over the whole run under the configured drop probability.
+
+        A probe/ack round trip fails with q = 1-(1-p)^2 per cycle (both
+        legs draw a coin — EmulNet.cpp:87-118 semantics); a false removal
+        needs k = TREMOVE/cycle *consecutive* failed cycles for one entry,
+        so by union bound the expected count is at most
+        ``N * VIEW_SIZE * (TOTAL_TIME/cycle) * q**k``.  Solving for the k
+        that brings that under 1 gives the sizing floor (tpu_hash.py module
+        docstring "Sizing under message loss"; validated empirically at
+        S=16, N>=65536 — artifacts/LOSS_STRESS.json).  Returns 0 when loss
+        or probing is off."""
+        import math
+
+        p = self.effective_drop_prob()
+        if p <= 0 or self.PROBES <= 0 or self.VIEW_SIZE <= 0:
+            return 0
+        q = 1.0 - (1.0 - p) ** 2
+        if q >= 1.0:
+            # Total loss: no finite TREMOVE avoids false removals; return
+            # an unreachable floor so the validate warning always fires.
+            return max(4, self.TOTAL_TIME)
+        cycle = -(-self.VIEW_SIZE // self.PROBES)
+        trials = (self.EN_GPSZ * self.VIEW_SIZE
+                  * max(self.TOTAL_TIME // cycle, 1))
+        return max(4, math.ceil(math.log(trials) / -math.log(q)))
 
     def drop_pct(self) -> int:
         """Integer drop percentage, quantized once.

@@ -1,0 +1,133 @@
+"""Fused circulant-gossip delivery: all ``fanout`` shifts in ONE Pallas
+traversal of the mailbox.
+
+The ring exchange (backends/tpu_hash.py make_step, 'ring' mode) delivers
+gossip by, per shift ``r_j``: mask the sender payload, roll rows by
+``r_j``, roll columns by ``r_j * STRIDE mod S``, and max into ``mail`` —
+at fanout F that is ~3F full [N, S] HBM passes, the majority of the
+per-tick budget after the receive pass was fused (PERF.md "The Pallas
+story").  This kernel is output-stationary instead: the grid walks
+``(mail block, shift)`` with the mail block resident in VMEM across all F
+shifts, and the *input* block index is computed from the shift via scalar
+prefetch — receiver rows ``[iB, iB+B)`` need sender rows
+``[iB - r_j, iB - r_j + B) mod N``, which always lie inside two adjacent
+payload blocks; an in-VMEM dynamic row slice assembles them and a dynamic
+lane roll applies the column alignment.  HBM traffic drops to one
+read+write of mail plus 2F block-reads of payload: ~(2F + 2) passes, and
+no [N, S] intermediate is ever materialized.
+
+Supported when (enforced by :func:`gossip_fused_supported`):
+
+* ``S % 128 == 0`` — whole-lane rows (same tiling rule as fused_receive);
+* ``(N * STRIDE) % S == 0`` — the wrapped/unwrapped receiver rows share
+  one column shift, matching the jnp path's single-roll fast case
+  (tpu_hash.py make_step: "they coincide iff N*STRIDE % S == 0");
+* no message drops — the jnp path draws a fresh [N, S] Bernoulli mask per
+  shift; replicating that stream in-kernel would fork the RNG semantics.
+  The drop-free configs are exactly the scale/bench regime.
+
+Semantics are pinned bit-exactly against the jnp shift loop in interpret
+mode (tests/test_fused_gossip.py) and end-to-end via the FUSED_GOSSIP
+conf key; the real Mosaic lowering is gated by scripts/tpu_correctness.py
+on hardware, like the receive kernel.
+
+Reference lineage: the delivery being fused is the TPU-native lowering of
+EmulNet message delivery + the LIST gossip burst
+(/root/reference/EmulNet.cpp:87-118, MP1Node.cpp:360-402); the circulant
+redesign itself is documented at tpu_hash.make_step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.ops.fused_receive import _pick_block
+
+I32 = jnp.int32
+U32 = jnp.uint32
+STRIDE = 7919   # must match tpu_hash.STRIDE (asserted in tests)
+
+
+def gossip_fused_supported(n: int, s: int) -> bool:
+    """Lane tiling + single-column-shift circulant case (module docstring)."""
+    return s % 128 == 0 and n >= 8 and (n * STRIDE) % s == 0
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
+                 mail: jax.Array, payload: jax.Array,
+                 k_eff: jax.Array, shifts: jax.Array) -> jax.Array:
+    """``max(mail, max_j roll2d(where(j < k_eff, payload, 0), shifts[j]))``.
+
+    Args:
+      mail:    [N, S] u32 receiver mailboxes (max-combined).
+      payload: [N, S] u32 keep-masked sender rows (0 where not gossiped);
+               the caller applies entry thinning / act masking.
+      k_eff:   [N] i32 per-sender effective fanout (shift j delivers rows
+               with ``j < k_eff``).
+      shifts:  [k_max] i32 circulant row shifts, values in [1, N).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = mail.shape[0]
+    b = _pick_block(rows)
+    nb = rows // b
+    cstride = STRIDE % s
+
+    def _lo_block(i, j, sh):
+        # Sender rows start at (i*b - sh[j]) mod rows; sh[j] in [1, rows)
+        # so one +rows keeps the dividend non-negative.
+        return jax.lax.rem(i * b - sh[j] + rows, rows) // b
+
+    def kernel(sh_ref, mail_ref, plo_ref, phi_ref, klo_ref, khi_ref,
+               out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        r = sh_ref[j]
+        start = jax.lax.rem(i * b - r + rows, rows)
+        off = jax.lax.rem(start, b)
+
+        rows2b = jnp.concatenate([plo_ref[:], phi_ref[:]], axis=0)
+        senders = jax.lax.dynamic_slice_in_dim(rows2b, off, b, axis=0)
+        ke2b = jnp.concatenate([klo_ref[:], khi_ref[:]], axis=0)
+        ke = jax.lax.dynamic_slice_in_dim(ke2b, off, b, axis=0)
+        senders = jnp.where((j < ke)[:, None], senders, U32(0))
+
+        # Column alignment: one shift for all rows (the supported case
+        # (N*STRIDE) % S == 0 — see module docstring).
+        s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+        delivered = pltpu.roll(senders, s1, axis=1)
+
+        @pl.when(j == 0)
+        def _init():
+            out_ref[:] = mail_ref[:]
+
+        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+
+    row_block = lambda i, j, sh: (i, 0)           # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, k_max),
+        in_specs=[
+            pl.BlockSpec((b, s), row_block),                       # mail
+            pl.BlockSpec((b, s), lambda i, j, sh:
+                         (_lo_block(i, j, sh), 0)),                # payload lo
+            pl.BlockSpec((b, s), lambda i, j, sh:
+                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
+            pl.BlockSpec((b,), lambda i, j, sh:
+                         (_lo_block(i, j, sh),)),                  # k_eff lo
+            pl.BlockSpec((b,), lambda i, j, sh:
+                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb),)),
+        ],
+        out_specs=pl.BlockSpec((b, s), row_block),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, s), U32),
+        interpret=interpret,
+    )(shifts.astype(I32), mail, payload, payload, k_eff.astype(I32),
+      k_eff.astype(I32))

@@ -36,7 +36,8 @@ def _example_scan_args(params, plan, ticks):
 
 
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
-               fanout: int = 3, cost: bool = False) -> dict:
+               fanout: int = 3, cost: bool = False,
+               fused_gossip: bool = False) -> dict:
     import random as _pyrandom
 
     import jax
@@ -54,6 +55,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
+        f"FUSED_GOSSIP: {int(fused_gossip)}\n"
         f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
 
@@ -77,7 +79,9 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     # Ring roofline passes (PERF.md): receive ~12 jnp / ~6 fused, gossip
     # ~3 per shift, probe/agg ~4.
     state_bytes = 3 * n * s * 4
-    passes = (6 if fused else 12) + 3 * min(cfg.fanout, cfg.s) + 4
+    gossip_passes = ((2 * min(cfg.fanout, cfg.s) + 2 + 2) if fused_gossip
+                     else 3 * min(cfg.fanout, cfg.s))
+    passes = (6 if fused else 12) + gossip_passes + 4
     est_gb_per_tick = passes * (n * s * 4) / 1e9
 
     # Objective pass count from the compiled step itself: XLA's cost
@@ -107,7 +111,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
             measured = {"cost_analysis_error": repr(e)[:120]}
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
-        "fused": fused, "fanout": cfg.fanout, "probes": cfg.probes,
+        "fused": fused, "fused_gossip": fused_gossip,
+        "fanout": cfg.fanout, "probes": cfg.probes,
         "platform": jax.default_backend(),
         # wall_seconds is a SECOND run on the warm jit cache; compile time
         # is isolated in compile_plus_first_run_s (VERDICT r2 item 8: every
@@ -135,6 +140,7 @@ def main() -> int:
                     choices=["ring", "scatter"])
     ap.add_argument("--fanout", type=int, default=3)
     ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
+    ap.add_argument("--fused-gossip", default="off", choices=["off", "on"])
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
@@ -150,7 +156,8 @@ def main() -> int:
     for n in ns:
         for fused in fused_opts:
             rec = time_point(n, args.view, args.ticks, args.exchange,
-                             fused, args.fanout, cost=args.cost)
+                             fused, args.fanout, cost=args.cost,
+                             fused_gossip=args.fused_gossip == "on")
             print(json.dumps(rec), flush=True)
     return 0
 

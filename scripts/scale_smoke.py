@@ -41,6 +41,21 @@ def main() -> int:
     ap.add_argument("--probes", type=int, default=8)
     ap.add_argument("--fanout", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--drop", type=float, default=0.0,
+                    help="message drop probability, applied over the whole "
+                         "run (loss stress in the scale regime; TREMOVE "
+                         "auto-sizes to the Params loss floor)")
+    ap.add_argument("--tremove-cycles", type=int, default=0,
+                    help="TREMOVE in probe cycles (0 = auto: 5, or the "
+                         "loss floor + 1 when --drop > 0)")
+    ap.add_argument("--rack-size", type=int, default=0,
+                    help="correlated rack failures: rack size in nodes")
+    ap.add_argument("--rack-failures", type=int, default=0,
+                    help="number of whole racks crashed at FAIL_TIME")
+    ap.add_argument("--trackers-floor", type=int, default=8,
+                    help="fail the run if any crashed id had fewer than "
+                         "this many live trackers at the crash (detection-"
+                         "quality floor, VERDICT r2 item 5)")
     ap.add_argument("--exchange", default="auto",
                     choices=["auto", "scatter", "ring"],
                     help="tpu_hash message-exchange lowering (auto picks "
@@ -73,16 +88,39 @@ def main() -> int:
 
     cycle = -(-args.view // args.probes)
     tfail = 2 * cycle
-    tremove = 5 * cycle
-    # 7 cycles of tail margin: refresh chains stretch the last detections
-    # past TREMOVE (tests/test_hash_backend.py bounds; ring runs a little
-    # longer-tailed than scatter).
-    fail_time = args.ticks - tremove - 7 * cycle
-    assert fail_time > 0, "ticks too short for the detection window"
+    k_cycles = args.tremove_cycles
+    if k_cycles == 0:
+        k_cycles = 5
+        if args.drop > 0:
+            # Size TREMOVE from the loss floor (expected false removals
+            # < 1 over the run — Params.min_tremove_cycles_under_loss),
+            # +1 cycle of margin.
+            probe = Params.from_text(
+                f"MAX_NNB: {args.n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
+                f"MSG_DROP_PROB: {args.drop}\nVIEW_SIZE: {args.view}\n"
+                f"PROBES: {args.probes}\nTREMOVE: {1 << 20}\n"
+                f"TOTAL_TIME: {args.ticks}\nJOIN_MODE: warm\n"
+                f"BACKEND: {args.backend}\n")
+            k_cycles = max(5, probe.min_tremove_cycles_under_loss() + 1)
+    tremove = k_cycles * cycle
+    # Tail margin: refresh chains stretch the last detections past TREMOVE
+    # (tests/test_hash_backend.py bounds; ring runs a little longer-tailed
+    # than scatter, loss stretches further still).
+    tail = (10 if args.drop > 0 else 7) * cycle
+    fail_time = args.ticks - tremove - tail
+    assert fail_time > 0, (
+        f"ticks too short for the detection window (need > "
+        f"{tremove + tail}; raise --ticks)")
 
+    drop_keys = (f"DROP_MSG: 1\nMSG_DROP_PROB: {args.drop}\n"
+                 f"DROP_START: 0\nDROP_STOP: {args.ticks}\n"
+                 if args.drop > 0 else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    rack_keys = (f"RACK_SIZE: {args.rack_size}\n"
+                 f"RACK_FAILURES: {args.rack_failures}\n"
+                 if args.rack_size > 0 and args.rack_failures > 0 else "")
     params = Params.from_text(
-        f"MAX_NNB: {args.n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
-        f"MSG_DROP_PROB: 0\nVIEW_SIZE: {args.view}\n"
+        f"MAX_NNB: {args.n}\nSINGLE_FAILURE: 1\n{drop_keys}{rack_keys}"
+        f"VIEW_SIZE: {args.view}\n"
         f"GOSSIP_LEN: {args.gossip}\nPROBES: {args.probes}\n"
         f"FANOUT: {args.fanout}\nTFAIL: {tfail}\nTREMOVE: {tremove}\n"
         f"TOTAL_TIME: {args.ticks}\nFAIL_TIME: {fail_time}\n"
@@ -94,8 +132,12 @@ def main() -> int:
     wall = time.time() - t0
     summary = result.extra["detection_summary"]
 
+    floor_ok = (summary.get("trackers_per_failed_min", args.trackers_floor)
+                >= args.trackers_floor)
     ok = (summary["false_removals"] == 0
-          and summary["observer_completeness"] == 1.0)
+          and summary["observer_completeness"] == 1.0
+          and summary.get("detected_by_someone", 1.0) == 1.0
+          and floor_ok)
     record = {
         "backend": args.backend,
         "platform": platform,
@@ -104,6 +146,10 @@ def main() -> int:
         "view_size": args.view, "gossip_len": args.gossip,
         "probes": args.probes, "fanout": args.fanout,
         "tfail": tfail, "tremove": tremove, "seed": args.seed,
+        "drop_prob": args.drop,
+        "rack_size": args.rack_size, "rack_failures": args.rack_failures,
+        "trackers_floor": args.trackers_floor, "trackers_floor_ok": floor_ok,
+        "timing": "cold_compile_included",
         # Both hash backends honor EXCHANGE (ring = circulant/torus rolls,
         # scatter = scatter-max / bucketed all_to_all); tpu_sparse has one
         # lowering.
@@ -126,8 +172,9 @@ def main() -> int:
         json.dump(existing, fh, indent=1)
     print(json.dumps(record))
     if not ok:
-        print("SCALE SMOKE FAILED: detection verdicts not clean",
-              file=sys.stderr)
+        why = ("trackers_per_failed_min below --trackers-floor"
+               if not floor_ok else "detection verdicts not clean")
+        print(f"SCALE SMOKE FAILED: {why}", file=sys.stderr)
         return 1
     return 0
 

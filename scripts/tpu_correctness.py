@@ -21,7 +21,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run_once(fused: bool, n: int = 8192, s: int = 128, ticks: int = 60):
+def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
+             n: int = 8192, s: int = 128, ticks: int = 60):
     import random as _pyrandom
 
     import numpy as np
@@ -30,13 +31,17 @@ def run_once(fused: bool, n: int = 8192, s: int = 128, ticks: int = 60):
     from distributed_membership_tpu.config import Params
     from distributed_membership_tpu.runtime.failures import make_plan
 
+    drop_keys = (
+        f"DROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+        f"DROP_START: 10\nDROP_STOP: {ticks - 10}\n" if drops else
+        "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
     params = Params.from_text(
-        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
-        f"DROP_START: 10\nDROP_STOP: {ticks - 10}\n"
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 4}\nPROBES: {s // 8}\n"
         f"FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
-        f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused)}\n"
+        f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
+        f"FUSED_GOSSIP: {int(fused_gossip)}\n"
         f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
     final_state, _ = run_scan(params, plan, seed=0, collect_events=False)
@@ -66,13 +71,28 @@ def main() -> int:
     backend = jax.default_backend()
     print(f"platform={platform} backend={backend}", flush=True)
 
-    base = run_once(fused=False, n=args.n, ticks=args.ticks)
-    fused = run_once(fused=True, n=args.n, ticks=args.ticks)
-    diffs = {k: int((base[k] != fused[k]).sum()) for k in base}
-    ok = all(v == 0 for v in diffs.values())
+    def diff(a, b):
+        return {k: int((a[k] != b[k]).sum()) for k in a}
+
+    checks = {}
+    # Receive kernel under the droppy config (its hardest regime).
+    base_d = run_once(False, False, True, n=args.n, ticks=args.ticks)
+    recv_d = run_once(True, False, True, n=args.n, ticks=args.ticks)
+    checks["fused_receive"] = diff(base_d, recv_d)
+    # Gossip kernel (drop-free by contract), alone and with the receive
+    # kernel — the composition is what FUSED defaults would ship.
+    base = run_once(False, False, False, n=args.n, ticks=args.ticks)
+    goss = run_once(False, True, False, n=args.n, ticks=args.ticks)
+    both = run_once(True, True, False, n=args.n, ticks=args.ticks)
+    checks["fused_gossip"] = diff(base, goss)
+    checks["fused_both"] = diff(base, both)
+
+    mism = {name: {k: v for k, v in d.items() if v}
+            for name, d in checks.items()}
+    ok = not any(mism.values())
     print(json.dumps({"check": "fused_vs_jnp_same_platform",
                       "platform": backend, "ok": ok,
-                      "mismatched_elements": diffs}))
+                      "mismatched_elements": mism}))
     return 0 if ok else 1
 
 

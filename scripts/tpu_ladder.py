@@ -35,23 +35,28 @@ sys.path.insert(0, REPO)
 
 OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 
-# (name, n, view, ticks, fused, timeout_s) — smallest first; timeouts sized
-# ~4x the expected wall so a hung relay is cut quickly.  The special first
-# rung runs scripts/tpu_correctness.py (fused-vs-jnp bit-equality on the
-# real Mosaic lowering) instead of a timing point.
-CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, False, 420)
+# (name, n, view, ticks, fused_mode, timeout_s) — smallest first; timeouts
+# sized ~4x the expected wall so a hung relay is cut quickly.  fused_mode:
+# 'off' | 'recv' (Pallas receive kernel) | 'gossip' (Pallas gossip
+# delivery) | 'both'.  The special first rung runs
+# scripts/tpu_correctness.py (fused-vs-jnp bit-equality for BOTH kernels
+# on the real Mosaic lowering — 5 scans) instead of a timing point; a
+# failure there gates every fused timing rung off.
+CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
 LADDER = [
     CORRECTNESS_RUNG,
-    ("65k_s64",        1 << 16,  64, 150, False, 240),
-    ("65k_s128",       1 << 16, 128, 100, False, 300),
-    ("65k_s128_fused", 1 << 16, 128, 100, True,  300),
-    ("262k_s64",       1 << 18,  64,  60, False, 420),
-    ("262k_s128",      1 << 18, 128,  60, False, 480),
-    ("1M_s16",         1 << 20,  16,  60, False, 600),
-    ("524k_s64",       1 << 19,  64,  60, False, 600),
-    ("1M_s64",         1 << 20,  64,  60, False, 900),
-    ("1M_s128",        1 << 20, 128,  40, False, 900),
-    ("1M_s128_fused",  1 << 20, 128,  40, True,  900),
+    ("65k_s64",          1 << 16,  64, 150, "off",    240),
+    ("65k_s128",         1 << 16, 128, 100, "off",    300),
+    ("65k_s128_frecv",   1 << 16, 128, 100, "recv",   300),
+    ("65k_s128_fgossip", 1 << 16, 128, 100, "gossip", 300),
+    ("65k_s128_fboth",   1 << 16, 128, 100, "both",   300),
+    ("262k_s64",         1 << 18,  64,  60, "off",    420),
+    ("262k_s128",        1 << 18, 128,  60, "off",    480),
+    ("1M_s16",           1 << 20,  16,  60, "off",    600),
+    ("524k_s64",         1 << 19,  64,  60, "off",    600),
+    ("1M_s64",           1 << 20,  64,  60, "off",    900),
+    ("1M_s128",          1 << 20, 128,  40, "off",    900),
+    ("1M_s128_fboth",    1 << 20, 128,  40, "both",   900),
 ]
 
 
@@ -85,7 +90,7 @@ def probe() -> str | None:
     return probe_platform(timeout=90, retries=2)
 
 
-def run_rung(name: str, n: int, s: int, ticks: int, fused: bool,
+def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
              timeout: float) -> dict | None:
     env = dict(os.environ)
     env["DM_RESOLVED_PLATFORM"] = "tpu"   # probe said yes; don't re-probe
@@ -97,7 +102,9 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: bool,
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
-               "--fused", "on" if fused else "off"]
+               "--fused", "on" if fused in ("recv", "both") else "off",
+               "--fused-gossip",
+               "on" if fused in ("gossip", "both") else "off"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True, env=env, cwd=REPO)
@@ -144,8 +151,8 @@ def _missing() -> list:
     fused_ok = corr is None or corr.get("ok", False)
     return [r for r in LADDER
             if r[0] not in done
-            and not (r[4] and r[2] % 128 != 0)
-            and not (r[4] and not fused_ok)]
+            and not (r[4] != "off" and r[2] % 128 != 0)
+            and not (r[4] != "off" and not fused_ok)]
 
 
 def one_pass() -> tuple[int, int]:
@@ -179,7 +186,7 @@ def one_pass() -> tuple[int, int]:
         if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
             # Gate fused timing rungs off THIS pass too, not just the next
             # (_missing() only sees the failure on re-read).
-            pending = [r for r in pending if not r[4]]
+            pending = [r for r in pending if r[4] == "off"]
         if "node_ticks_per_sec" in rec:
             print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
                   f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)

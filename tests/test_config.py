@@ -48,3 +48,65 @@ def test_start_tick_schedule():
     assert [p.start_tick(i) for i in range(10)] == [0, 0, 0, 0, 1, 1, 1, 1, 2, 2]
     p.JOIN_MODE = "batch"
     assert [p.start_tick(i) for i in range(10)] == [0] * 10
+
+
+def test_min_tremove_cycles_under_loss():
+    from distributed_membership_tpu.config import Params
+
+    base = ("MAX_NNB: 65536\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
+            "MSG_DROP_PROB: 0.1\nVIEW_SIZE: 16\nPROBES: 2\nTFAIL: 16\n"
+            "TREMOVE: 1000\nTOTAL_TIME: 260\nJOIN_MODE: warm\n"
+            "BACKEND: tpu_hash\n")
+    p = Params.from_text(base)
+    k = p.min_tremove_cycles_under_loss()
+    # q = 1-(0.9)^2 = 0.19; trials = 65536*16*(260//8) ~ 3.4e7;
+    # ln(trials)/-ln(q) ~ 17.3/1.66 ~ 10.4 -> 11.
+    assert k == 11, k
+
+    # Loss off -> no floor.
+    p2 = Params.from_text(base.replace("DROP_MSG: 1", "DROP_MSG: 0"))
+    assert p2.min_tremove_cycles_under_loss() == 0
+
+    # Heavier loss demands more cycles.
+    p3 = Params.from_text(base.replace("MSG_DROP_PROB: 0.1",
+                                       "MSG_DROP_PROB: 0.2"))
+    assert p3.min_tremove_cycles_under_loss() > k
+
+
+def test_tremove_loss_floor_warns():
+    import warnings
+
+    from distributed_membership_tpu.config import Params
+
+    text = ("MAX_NNB: 65536\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
+            "MSG_DROP_PROB: 0.1\nVIEW_SIZE: 16\nPROBES: 2\nTFAIL: 16\n"
+            "TREMOVE: 40\nTOTAL_TIME: 260\nJOIN_MODE: warm\n"
+            "BACKEND: tpu_hash\n")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        Params.from_text(text)   # 5 cycles < the 11-cycle floor
+    assert any("probe cycles" in str(x.message) for x in w), [
+        str(x.message) for x in w]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        Params.from_text(text.replace("TREMOVE: 40", "TREMOVE: 96"))
+    assert not w, [str(x.message) for x in w]
+
+
+def test_probe_attribution_exact_flag():
+    from distributed_membership_tpu.backends.tpu_hash import (
+        PROBE_IO_EXACT_MAX, probe_attribution_exact)
+    from distributed_membership_tpu.config import Params
+
+    def mk(n, exchange="ring", probes=8):
+        return Params.from_text(
+            f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            f"MSG_DROP_PROB: 0\nVIEW_SIZE: 64\nGOSSIP_LEN: 16\n"
+            f"PROBES: {probes}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 100\n"
+            f"JOIN_MODE: warm\nEXCHANGE: {exchange}\nBACKEND: tpu_hash\n")
+
+    assert probe_attribution_exact(mk(PROBE_IO_EXACT_MAX))
+    assert not probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2))
+    # Scatter mode and probe-free configs attribute exactly at any N.
+    assert probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2, "scatter"))

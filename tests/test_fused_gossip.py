@@ -1,0 +1,119 @@
+"""Pallas fused gossip delivery == the jnp circulant shift loop, bit-exact.
+
+The kernel (ops/fused_gossip) re-expresses the ring exchange's per-shift
+roll+max loop as one output-stationary traversal; this test pins the
+plumbing that could drift: scalar-prefetch block indexing, the in-VMEM
+dynamic row slice across the two fetched blocks, the dynamic lane roll,
+and the accumulate-across-shifts output revisiting.  Runs in interpret
+mode (no TPU needed); the Mosaic lowering is gated on hardware by
+scripts/tpu_correctness.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.backends import tpu_hash
+from distributed_membership_tpu.ops.fused_gossip import (
+    STRIDE, gossip_fused, gossip_fused_supported)
+
+
+def test_stride_matches_backend():
+    assert STRIDE == tpu_hash.STRIDE
+
+
+def _jnp_reference(n, s, k_max, mail, payload, k_eff, shifts):
+    """The ring branch's shift loop (tpu_hash.make_step), drop-free case."""
+    cstride = STRIDE % s
+    for j in range(k_max):
+        m = (j < k_eff)[:, None]
+        rolled = jnp.roll(jnp.where(m, payload, jnp.uint32(0)),
+                          shifts[j], axis=0)
+        s1 = (int(shifts[j]) % s) * cstride % s
+        mail = jnp.maximum(mail, jnp.roll(rolled, s1, axis=1))
+    return mail
+
+
+@pytest.mark.parametrize("n,s,k_max", [(256, 128, 3), (128, 128, 1),
+                                       (512, 256, 4), (384, 128, 3)])
+def test_fused_matches_loop(n, s, k_max):
+    assert gossip_fused_supported(n, s)
+    key = jax.random.PRNGKey(n + k_max)
+    ks = jax.random.split(key, 5)
+    mail = jax.random.randint(ks[0], (n, s), 0, 1 << 20).astype(jnp.uint32)
+    payload = jnp.where(
+        jax.random.bernoulli(ks[1], 0.3, (n, s)),
+        jax.random.randint(ks[2], (n, s), 1, 1 << 20).astype(jnp.uint32),
+        jnp.uint32(0))
+    k_eff = jax.random.randint(ks[3], (n,), 0, k_max + 1)
+    shifts = jax.random.randint(ks[4], (k_max,), 1, n)
+
+    ref = _jnp_reference(n, s, k_max, mail, payload, k_eff, shifts)
+    got = gossip_fused(n, s, k_max, True, mail, payload, k_eff, shifts)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_unsupported_shapes_rejected():
+    # S not lane-aligned, and N not a multiple of S (odd STRIDE).
+    assert not gossip_fused_supported(1 << 16, 16)
+    assert not gossip_fused_supported(100, 128)
+
+
+def test_boundary_shifts():
+    """Shifts 1 and N-1 exercise both block-wrap extremes."""
+    n, s = 256, 128
+    key = jax.random.PRNGKey(7)
+    payload = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)
+    mail = jnp.zeros((n, s), jnp.uint32)
+    k_eff = jnp.full((n,), 2, jnp.int32)
+    shifts = jnp.array([1, n - 1], jnp.int32)
+    ref = _jnp_reference(n, s, 2, mail, payload, k_eff, shifts)
+    got = gossip_fused(n, s, 2, True, mail, payload, k_eff, shifts)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_fused_run_matches_default_end_to_end():
+    """FUSED_GOSSIP=1 must reproduce the default ring run exactly: same
+    seed, same keys, same trajectory — events and final state identical."""
+    import random
+
+    from distributed_membership_tpu.backends.tpu_hash import run_scan
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    def run(fused):
+        p = Params.from_text(
+            "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+            "VIEW_SIZE: 128\nGOSSIP_LEN: 16\nPROBES: 16\nTFAIL: 16\n"
+            "TREMOVE: 40\nTOTAL_TIME: 130\nFAIL_TIME: 70\nJOIN_MODE: warm\n"
+            f"EXCHANGE: ring\nFUSED_GOSSIP: {fused}\nBACKEND: tpu_hash\n")
+        plan = make_plan(p, random.Random("app:0"))
+        return run_scan(p, plan, seed=0)
+
+    fs0, ev0 = run(0)
+    fs1, ev1 = run(1)
+    np.testing.assert_array_equal(np.asarray(ev0.join_ids),
+                                  np.asarray(ev1.join_ids))
+    np.testing.assert_array_equal(np.asarray(ev0.rm_ids),
+                                  np.asarray(ev1.rm_ids))
+    np.testing.assert_array_equal(np.asarray(ev0.sent), np.asarray(ev1.sent))
+    np.testing.assert_array_equal(np.asarray(ev0.recv), np.asarray(ev1.recv))
+    np.testing.assert_array_equal(np.asarray(fs0.view), np.asarray(fs1.view))
+    np.testing.assert_array_equal(np.asarray(fs0.view_ts),
+                                  np.asarray(fs1.view_ts))
+    np.testing.assert_array_equal(np.asarray(fs0.mail), np.asarray(fs1.mail))
+
+
+def test_fused_gossip_with_drops_rejected():
+    from distributed_membership_tpu.backends.tpu_hash import make_config
+    from distributed_membership_tpu.config import Params
+
+    p = Params.from_text(
+        "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+        "VIEW_SIZE: 128\nGOSSIP_LEN: 16\nPROBES: 16\nTFAIL: 16\n"
+        "TREMOVE: 64\nTOTAL_TIME: 130\nFAIL_TIME: 70\nJOIN_MODE: warm\n"
+        "EXCHANGE: ring\nFUSED_GOSSIP: 1\nBACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="drop-free"):
+        make_config(p)
