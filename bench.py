@@ -161,6 +161,9 @@ def _best_banked_tpu() -> dict | None:
                 continue   # correctness rungs etc.
             if r.get("mesh_size", 1) != 1:
                 continue   # mesh-aggregate rate; headline unit is per-chip
+            if not r.get("verdict_ok", True) or r.get("drop_prob", 0) > 0:
+                continue   # loss-stress / failed-verdict rows aren't
+                #            headline perf evidence
             s = r.get("s", r.get("view_size", 0))
             gbps = r.get("implied_hbm_gbps", r.get("est_hbm_gbps"))
             if gbps is None and s and r.get("wall_seconds") and r.get(

@@ -185,8 +185,9 @@ def bucket_capacity(cfg: HashConfig, n_local: int, n_shards: int) -> int:
     return min(cap, n_local * per_sender + seed_total)
 
 
-def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
-    """Ring exchange on the sharded backend (EXCHANGE ring, JOIN_MODE warm).
+def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
+                           cold_join: bool = False):
+    """Ring exchange on the sharded backend (EXCHANGE ring).
 
     Gossip shifts are torus-product translations ``(j, d) -> (j+c, d+b)``
     with ``u = b*L + c ~ U[1, N)`` re-drawn per shift per tick: the block
@@ -202,8 +203,20 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
     of the lagged heartbeat vector per tick (4 MB at N=1M — the whole
     cross-shard probe subsystem).  Per-node probe counters use prober
     attribution (per-target attribution would need [N] psums per tick);
-    totals remain comparable.  The join/seed machinery is skipped — warm
-    mode is enforced by run_scan_sharded, where it is inert anyway.
+    totals remain comparable.
+
+    With ``cold_join`` the full join handshake runs
+    (MP1Node.cpp:126-163,226-251 semantics, as the single-chip ring and
+    the scatter-mode sharded step implement it).  The key observation
+    keeping it cheap: the introducer's receive/act flags are deterministic
+    functions of the replicated schedules (its ``in_group`` comes from its
+    own boot, never from messages), so the whole control plane —
+    JOINREQ/JOINREP bits, seed selection, drop coins — is computed
+    *replicated* on every shard from the shared tick key; the only
+    cross-shard traffic added is one [N]-bool ``all_gather`` of the
+    in-flight JOINREQ bits and two [S] ``psum`` broadcasts of the
+    introducer's row for the seed burst.  In warm mode (the scale
+    regime) all of it compiles away — the fast path is unchanged.
 
     The union of ``fanout`` torus translations re-drawn each tick is an
     expander family with uniform target marginals, like the single-chip
@@ -251,6 +264,56 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = recv_mask[:, None]
 
+        # ---- join handshake control plane (cold_join only) ----
+        # Replicated computation throughout: the introducer's receive/act
+        # state is schedule-deterministic, so every shard derives the same
+        # control vectors from the shared key (docstring).
+        if cold_join:
+            is_intro_row = lrows == INTRO
+            idx_g = jnp.arange(n, dtype=I32)
+            intro_failed = fail_mask_g[INTRO] & (t > fail_time)
+            intro_recv = ((t > start_ticks_g[INTRO]) & ~intro_failed)
+            if use_drop:
+                k_ctrl = jax.random.fold_in(key, 0xC281)
+                ctrl_kept_g = ~(jax.random.bernoulli(
+                    k_ctrl, cfg.drop_prob, (2, n)) & drop_active)
+            else:
+                ctrl_kept_g = jnp.ones((2, n), bool)
+
+            in_group = state.in_group | (state.joinrep_infl & recv_mask)
+            joinrep_infl = state.joinrep_infl & ~recv_mask
+
+            joinreq_g = lax.all_gather(state.joinreq_infl, NODE_AXIS,
+                                       tiled=True)
+            seeds_g = joinreq_g & intro_recv
+            joinreq_infl = state.joinreq_infl & ~intro_recv
+            rep_ok_g = seeds_g & ctrl_kept_g[1]
+            rep_ok_l = lax.dynamic_slice(rep_ok_g, (row0,), (n_local,))
+            joinrep_infl = joinrep_infl | rep_ok_l
+            n_seeds = seeds_g.sum(dtype=I32)
+            sent_rep = jnp.where(is_intro_row & intro_recv,
+                                 rep_ok_g.sum(dtype=I32), 0)
+
+            start_now = t == start_ticks_l
+            started = state.started | start_now
+            boot = t == start_ticks_g[INTRO]
+            in_group = in_group | (is_intro_row & boot)
+            ctrl0_l = lax.dynamic_slice(ctrl_kept_g[0], (row0,), (n_local,))
+            joiner_req = start_now & (lrows != INTRO) & ctrl0_l
+            joinreq_infl = joinreq_infl | joiner_req
+            sent_req = joiner_req.astype(I32)
+            joiner_req_g = ((t == start_ticks_g) & (idx_g != INTRO)
+                            & ctrl_kept_g[0])
+            pending_joins = (rep_ok_l.astype(I32)
+                             + jnp.where(is_intro_row,
+                                         joiner_req_g.sum(dtype=I32), 0))
+        else:
+            started, in_group = state.started, state.in_group
+            joinreq_infl = state.joinreq_infl
+            joinrep_infl = state.joinrep_infl
+            sent_req = sent_rep = jnp.zeros((n_local,), I32)
+            pending_joins = jnp.zeros((n_local,), I32)
+
         ack_recv_cnt = jnp.zeros((n_local,), I32)
         cand_full = jnp.zeros((n_local, s), U32)
         if cfg.probes > 0:
@@ -275,13 +338,14 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
             ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
-        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+        pending_recv = (jnp.where(recv_mask, 0, state.pending_recv)
+                        + pending_joins)
 
         # ---- self refresh vectors ----
-        act = (state.started & (t > start_ticks_l) & ~state.failed
-               & state.in_group)
+        act = (started & (t > start_ticks_l) & ~state.failed & in_group)
         own_hb = state.self_hb + 1
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_on = (act | (is_intro_row & boot)) if cold_join else act
         self_val = pack(cfg, jnp.where(act, own_hb, 0), lrows)
 
         recv_fn = (
@@ -293,16 +357,38 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
                 n, s, cfg.tfail, cfg.tremove, STRIDE, *a)))
         (view, view_ts, mail, join_mask, rm_ids, numfailed,
          size) = recv_fn(t, state.view, state.view_ts, state.mail,
-                         cand_full, recv_mask, act, act, self_val, lrows)
+                         cand_full, recv_mask, act, self_on, self_val,
+                         lrows)
         cur_id, cur_hb, present = unpack(cfg, view)
         join_ids = jnp.where(join_mask, cur_id, EMPTY)
         difft = t - view_ts
+
+        if cold_join:
+            # This tick's JOINREQ entries land in the introducer's mailbox
+            # row (hb 0, joiner id) — a local scatter on the owning shard;
+            # every shard knows joiner_req_g (replicated control plane).
+            intro_here = (INTRO >= row0) & (INTRO < row0 + n_local)
+            intro_local = jnp.clip(INTRO - row0, 0, n_local - 1)
+            jr_valid = joiner_req_g & intro_here
+            jr_addr = jnp.where(
+                jr_valid,
+                intro_local * s + slot_of(cfg, jnp.full((n,), INTRO, I32),
+                                          idx_g),
+                n_local * s)
+            mail = mail.reshape(-1).at[jr_addr].max(
+                jnp.where(jr_valid, (idx_g + 1).astype(U32), 0),
+                mode="drop").reshape(n_local, s)
 
         # ---- gossip: torus-product circulant shifts ----
         numpotential = size - 1 - numfailed
         fresh = present & (difft < cfg.tfail)
         is_self_slot = cur_id == lrows[:, None]
         k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
+        if cold_join:
+            # Seeded joiners consume gossip slots on the introducer's row
+            # (MP1Node.cpp:240-242 newNodes seeding, as single-chip ring).
+            n_seeds_row = jnp.where(is_intro_row & act, n_seeds, 0)
+            k_eff = jnp.clip(k_eff - n_seeds_row, 0)
         if g >= s:
             keep = fresh
         else:
@@ -351,7 +437,49 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
                 result = jnp.where((l_idx >= c)[:, None], r1, r2)
             mail = jnp.maximum(mail, result)
             recv_add = recv_add + cnt_r
-        sent_tick = sent_gossip
+        sent_tick = sent_gossip + sent_req + sent_rep
+
+        if cold_join:
+            # Introducer burst: its full fresh post-sweep view to each of
+            # this tick's seeded joiners.  The row is broadcast with two
+            # [S] psums; each shard delivers locally to the seed rows it
+            # owns.  Burst drop coins come from a replicated stream so the
+            # sender-side counter and receiver-side delivery agree.
+            row_view = lax.psum(
+                jnp.where(intro_here, view[intro_local], U32(0)), NODE_AXIS)
+            row_ts = lax.psum(
+                jnp.where(intro_here, view_ts[intro_local], 0), NODE_AXIS)
+            b_id, b_hb, b_present = unpack(cfg, row_view)
+            b_fresh = b_present & ((t - row_ts) < cfg.tfail)
+            cap = min(cfg.seed_cap, n)
+            _, seed_idx = jax.lax.top_k(seeds_g.astype(I32), cap)
+            seed_burst_on = (t > start_ticks_g[INTRO]) & ~intro_failed
+            seed_valid = seeds_g[seed_idx] & seed_burst_on
+            burst_valid = seed_valid[:, None] & b_fresh[None, :]
+            if use_drop:
+                k_burst = jax.random.fold_in(key, 0xB125)
+                burst_valid = burst_valid & ~(
+                    jax.random.bernoulli(k_burst, cfg.drop_prob, (cap, s))
+                    & drop_active)
+            owned = (seed_idx >= row0) & (seed_idx < row0 + n_local)
+            lrow = jnp.clip(seed_idx - row0, 0, n_local - 1)
+            b_addr = jnp.where(
+                owned[:, None] & burst_valid,
+                lrow[:, None] * s + slot_of(cfg, seed_idx[:, None],
+                                            jnp.clip(b_id, 0)[None, :]),
+                n_local * s)
+            b_val = jnp.where(burst_valid,
+                              pack(cfg, jnp.clip(b_hb, 0),
+                                   jnp.clip(b_id, 0))[None, :], 0)
+            mail = mail.reshape(-1).at[b_addr.reshape(-1)].max(
+                b_val.reshape(-1), mode="drop").reshape(n_local, s)
+            burst_total = burst_valid.sum(dtype=I32)
+            sent_tick = sent_tick + jnp.where(is_intro_row & act,
+                                              burst_total, 0)
+            recv_add = recv_add + jnp.zeros((n_local + 1,), I32).at[
+                jnp.where(owned, lrow, n_local)].add(
+                    burst_valid.sum(1, dtype=I32) * seed_valid.astype(I32),
+                    mode="drop")[:n_local]
 
         # ---- probe issue ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
@@ -401,9 +529,9 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
                 lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
 
         new_state = ShardedHashState(
-            view, view_ts, state.started, state.in_group, failed, self_hb,
-            mail, state.amail, state.pmail, state.joinreq_infl,
-            state.joinrep_infl, pending_recv, agg,
+            view, view_ts, started, in_group, failed, self_hb,
+            mail, state.amail, state.pmail, joinreq_infl,
+            joinrep_infl, pending_recv, agg,
             probe_ids1, probe_ids2, act_prev)
         return new_state, out
 
@@ -773,8 +901,9 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     if cache_key not in _RUNNER_CACHE:
         n_shards = mesh.shape[NODE_AXIS]
         ring = cfg.exchange == "ring"
-        step = (make_ring_sharded_step if ring
-                else make_sharded_step)(cfg, n_local, n_shards)
+        step = (make_ring_sharded_step(cfg, n_local, n_shards,
+                                       cold_join=not warm) if ring
+                else make_sharded_step(cfg, n_local, n_shards))
 
         def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
                       drop_lo, drop_hi, warm_key):
@@ -828,11 +957,6 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     n_local = n // d
     fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
     cfg = make_config(params, collect_events, fail_ids=fail_ids)
-    if cfg.exchange == "ring" and params.JOIN_MODE != "warm":
-        # The ring step skips the cold-join handshake machinery (inert in
-        # warm mode); EXCHANGE auto never selects this combination.
-        raise ValueError("EXCHANGE ring on tpu_hash_sharded requires "
-                         "JOIN_MODE warm")
     if cfg.fused_receive:
         # make_config validated against global N; the kernel runs over the
         # LOCAL rows here.

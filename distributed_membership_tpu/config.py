@@ -232,11 +232,13 @@ class Params:
         legs draw a coin — EmulNet.cpp:87-118 semantics); a false removal
         needs k = TREMOVE/cycle *consecutive* failed cycles for one entry,
         so by union bound the expected count is at most
-        ``N * VIEW_SIZE * (TOTAL_TIME/cycle) * q**k``.  Solving for the k
-        that brings that under 1 gives the sizing floor (tpu_hash.py module
-        docstring "Sizing under message loss"; validated empirically at
-        S=16, N>=65536 — artifacts/LOSS_STRESS.json).  Returns 0 when loss
-        or probing is off."""
+        ``N * VIEW_SIZE * (TOTAL_TIME/cycle) * q**k``.  The floor sizes k
+        so that bound is <= 0.01, not merely < 1: the knee is sharp — at
+        N=65536, S=16, p=0.1 a k targeting expectation < 1 still produced
+        one false removal (artifacts/LOSS_STRESS.json maps the knee), so
+        the ln(100) ~ 4.6 tightening (~3 extra cycles at p=0.1) buys the
+        measured-zero regime.
+        Returns 0 when loss or probing is off."""
         import math
 
         p = self.effective_drop_prob()
@@ -250,7 +252,7 @@ class Params:
         cycle = -(-self.VIEW_SIZE // self.PROBES)
         trials = (self.EN_GPSZ * self.VIEW_SIZE
                   * max(self.TOTAL_TIME // cycle, 1))
-        return max(4, math.ceil(math.log(trials) / -math.log(q)))
+        return max(4, math.ceil(math.log(trials / 0.01) / -math.log(q)))
 
     def drop_pct(self) -> int:
         """Integer drop percentage, quantized once.

@@ -149,22 +149,14 @@ def test_ring_drop_window_on_mesh():
     assert s["detected_by_someone"] == 1.0, s
 
 
-def test_ring_cold_join_contract():
-    """The flagship ring exchange is warm-only today: the explicit raise
-    plus the guarantee that EXCHANGE auto can never strand a cold-join
-    config on ring (VERDICT r2 item 7 contract).  Exercises every
-    JOIN_MODE x bounded/unbounded-view combination."""
-    import jax
-
-    from distributed_membership_tpu.backends.tpu_hash_sharded import (
-        make_mesh, run_scan_sharded)
-
+def test_exchange_auto_never_rings_cold_joins():
+    """EXCHANGE auto keeps picking scatter for cold-join configs (the
+    grader-parity regime pins scatter distributions); ring is selected
+    only for warm bounded-view scale runs."""
     base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
             "VIEW_SIZE: 16\nGOSSIP_LEN: 4\nPROBES: 2\nTFAIL: 16\n"
             "TREMOVE: 64\nTOTAL_TIME: 40\nFAIL_TIME: 20\n"
             "BACKEND: tpu_hash_sharded\n")
-
-    # 1. auto never resolves to ring unless JOIN_MODE is warm.
     for mode in ("staggered", "batch", "warm"):
         for view in (0, 16):
             p = Params.from_text(base + f"JOIN_MODE: {mode}\n"
@@ -173,10 +165,28 @@ def test_ring_cold_join_contract():
             if p.resolved_exchange() == "ring":
                 assert mode == "warm", (mode, view)
 
-    # 2. forcing ring with a cold join mode raises, never silently warms.
-    p = Params.from_text(base + "JOIN_MODE: batch\nEXCHANGE: ring\n")
-    plan = make_plan(p, random.Random("app:0"))
-    mesh = make_mesh(max(d for d in range(1, len(jax.devices()) + 1)
-                         if 64 % d == 0))
-    with pytest.raises(ValueError, match="JOIN_MODE warm"):
-        run_scan_sharded(p, plan, seed=0, mesh=mesh)
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_ring_cold_join_passes_grader(testcases_dir, scenario):
+    """The flagship ring exchange runs the grader's ACTUAL join scenario:
+    cold-join handshake (JOINREQ/JOINREP/seed burst) over the replicated
+    control plane (make_ring_sharded_step cold_join; VERDICT r2 item 7,
+    closing the warm-only gap)."""
+    params = Params.from_file(str(testcases_dir / f"{scenario}.conf"))
+    params.BACKEND = "tpu_hash_sharded"
+    params.EXCHANGE = "ring"
+    result = get_backend("tpu_hash_sharded")(params, seed=3)
+    assert result.extra["mesh_size"] == 5
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
+
+
+def test_ring_cold_join_latency_window(testcases_dir):
+    params = Params.from_file(str(testcases_dir / "singlefailure.conf"))
+    params.BACKEND = "tpu_hash_sharded"
+    params.EXCHANGE = "ring"
+    lat = removal_latencies(
+        get_backend("tpu_hash_sharded")(params, seed=3).log.dbg_text(), 100)
+    assert len(lat) == 9
+    assert set(lat) <= {21, 22, 23}, lat
