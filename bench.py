@@ -75,11 +75,21 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     s = int(os.environ.get("BENCH_VIEW", "128"))
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
+    # BENCH_FUSED=recv|gossip|both turns on the Pallas kernels (ring mode,
+    # S % 128 == 0; see PERF.md) — off by default until the correctness
+    # rung has passed on hardware.
+    fused = os.environ.get("BENCH_FUSED", "off")
+    if fused not in ("off", "recv", "gossip", "both"):
+        raise SystemExit(f"BENCH_FUSED must be off|recv|gossip|both, "
+                         f"got {fused!r}")
+    fused_keys = (f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
+                  f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n")
     params = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
         f"TFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
-        f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nBACKEND: tpu_hash\n")
+        f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n{fused_keys}"
+        f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
     wall, final_state = _timed_runs(run_scan, params, plan, ticks)
 
@@ -89,7 +99,15 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     # mail per circulant shift (backends/tpu_hash.py make_step).
     cfg = make_config(params, collect_events=False)
     if cfg.exchange == "ring":
-        passes = 2 * 3 + 3 * min(cfg.fanout, cfg.s)
+        # Pass model mirrors PERF.md.  The receive share stays 6 (one
+        # read+write of view/ts/mail — the ideal the unfused model already
+        # assumed; the Pallas kernel guarantees it rather than beating
+        # it); the gossip kernel cuts ~3F roll passes to ~2F+2, so the
+        # implied-HBM figure stays honest under BENCH_FUSED.
+        gossip_passes = (2 * min(cfg.fanout, cfg.s) + 2
+                         if cfg.fused_gossip
+                         else 3 * min(cfg.fanout, cfg.s))
+        passes = 2 * 3 + gossip_passes
         state_bytes = n * cfg.s * 4
         est_gb_per_tick = passes * state_bytes / 1e9
     else:
@@ -98,6 +116,7 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
 
     return {
         "leg": "hash", "platform": platform, "n": n, "ticks": ticks,
+        "fused": fused,
         "node_ticks_per_sec": round(n * ticks / wall, 1),
         "wall_seconds": round(wall, 3),
         "ticks_per_sec": round(ticks / wall, 2),

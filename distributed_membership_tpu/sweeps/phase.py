@@ -44,9 +44,11 @@ class SweepSpec:
     tremove: int = 24
     ticks: int = 120
     fail_time: int = 60
+    exchange: str = "auto"   # both lowerings sweepable (VERDICT r2 weak-7)
     fanouts: Sequence[int] = tuple(range(1, 9))
     drop_rates: Sequence[float] = (0.0, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3)
     seeds: Sequence[int] = (0, 1, 2)
+    name: str = "phase_sweep"   # artifact file stem
 
     def to_params(self) -> Params:
         # fanout here is only the static bound; cells pass theirs dynamically.
@@ -57,7 +59,19 @@ class SweepSpec:
             f"FANOUT: {max(self.fanouts)}\nTFAIL: {self.tfail}\n"
             f"TREMOVE: {self.tremove}\nTOTAL_TIME: {self.ticks}\n"
             f"FAIL_TIME: {self.fail_time}\nJOIN_MODE: warm\n"
-            f"EVENT_MODE: agg\nBACKEND: tpu_hash\n")
+            f"EVENT_MODE: agg\nEXCHANGE: {self.exchange}\n"
+            f"BACKEND: tpu_hash\n")
+
+    @staticmethod
+    def north_star() -> "SweepSpec":
+        """The S=16 scale regime (N=65536, cycle 8) at the 5-cycle default
+        TREMOVE: maps the loss knee Params.min_tremove_cycles_under_loss
+        guards against, at the scale the claims are quoted for."""
+        return SweepSpec(
+            n=65536, view_size=16, gossip_len=4, probes=2, tfail=16,
+            tremove=40, ticks=160, fail_time=80,
+            fanouts=(3,), drop_rates=(0.0, 0.05, 0.1, 0.15, 0.25),
+            seeds=(0, 1), name="phase_sweep_s16")
 
 
 def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
@@ -156,11 +170,12 @@ def summarize(records: list[dict]) -> list[dict]:
     return rows
 
 
-def write_artifacts(records, rows, out_dir: str) -> None:
+def write_artifacts(records, rows, out_dir: str,
+                    name: str = "phase_sweep") -> None:
     os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "phase_sweep_runs.json"), "w") as fh:
+    with open(os.path.join(out_dir, f"{name}_runs.json"), "w") as fh:
         json.dump(records, fh, indent=1)
-    with open(os.path.join(out_dir, "phase_sweep_grid.csv"), "w") as fh:
+    with open(os.path.join(out_dir, f"{name}_grid.csv"), "w") as fh:
         cols = list(rows[0].keys())
         fh.write(",".join(cols) + "\n")
         for r in rows:

@@ -23,28 +23,41 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--n", type=int, default=0)
+    ap.add_argument("--regime", default="default",
+                    choices=["default", "s16"],
+                    help="'s16' = the N=65536 S=16 north-star slice "
+                         "(SweepSpec.north_star)")
+    ap.add_argument("--exchange", default="auto",
+                    choices=["auto", "ring", "scatter"])
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
     from distributed_membership_tpu.runtime.platform import resolve_platform
     platform = resolve_platform(pin=args.platform)
 
+    import dataclasses
+
     from distributed_membership_tpu.sweeps.phase import (
         SweepSpec, run_sweep, summarize, write_artifacts)
 
+    spec = (SweepSpec.north_star() if args.regime == "s16" else SweepSpec())
     kwargs = {}
     if args.quick:
         kwargs = dict(fanouts=(1, 3, 6), drop_rates=(0.0, 0.1, 0.3),
-                      seeds=(0, 1), n=1024)
+                      seeds=(0, 1), n=1024, name=f"{spec.name}_quick")
     if args.n:
         kwargs["n"] = args.n
-    spec = SweepSpec(**kwargs)
+        kwargs["name"] = f"{kwargs.get('name', spec.name)}_n{args.n}"
+    if args.exchange != "auto":
+        kwargs["exchange"] = args.exchange
+        kwargs["name"] = f"{kwargs.get('name', spec.name)}_{args.exchange}"
+    spec = dataclasses.replace(spec, **kwargs)
 
     t0 = time.time()
     records = run_sweep(spec)
     wall = time.time() - t0
     rows = summarize(records)
-    write_artifacts(records, rows, OUT_DIR)
+    write_artifacts(records, rows, OUT_DIR, name=spec.name)
     print(json.dumps({
         "platform": platform, "cells": len(rows), "runs": len(records),
         "n": spec.n, "wall_seconds": round(wall, 1),

@@ -43,8 +43,13 @@ OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 # on the real Mosaic lowering — 5 scans) instead of a timing point; a
 # failure there gates every fused timing rung off.
 CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
+# Cheap hardware probe of the S<128 lane-padding premise (PERF.md) —
+# memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
+# timing; decides whether the folded layout is the next step.
+LAYOUT_RUNG = ("layout_probe", 1 << 20, 16, 0, "off", 420)
 LADDER = [
     CORRECTNESS_RUNG,
+    LAYOUT_RUNG,
     ("65k_s64",          1 << 16,  64, 150, "off",    240),
     ("65k_s128",         1 << 16, 128, 100, "off",    300),
     ("65k_s128_frecv",   1 << 16, 128, 100, "recv",   300),
@@ -98,6 +103,10 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_correctness.py"),
                "--n", str(n), "--ticks", str(ticks)]
+    elif name == LAYOUT_RUNG[0]:
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "tpu_layout_probe.py"),
+               "--n", str(n)]
     else:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),

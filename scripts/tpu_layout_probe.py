@@ -1,0 +1,129 @@
+"""Hardware probe for the S<128 lane-padding premise (PERF.md).
+
+TPU tiles the minormost array axis to 128 lanes, so a ``[N, 16]`` u32
+plane should occupy (and stream at) ~8x its logical size, and the folded
+``[N/8, 128]`` layout should close the gap.  This script turns that
+premise into evidence on whatever platform resolves:
+
+1. device memory held by a ``[N, S]`` u32 allocation for S in {16, 128}
+   (via ``device.memory_stats()``; absent on CPU — reported null);
+2. warm-cache timing of the ring-gossip inner op (row roll + lane roll +
+   max-accumulate) on the padded ``[N, 16]`` layout vs the equivalent
+   folded ``[N/8, 128]`` op pair (aligned sublane roll + carry-select
+   lane roll);
+3. the implied effective HBM GB/s of each, so the folded win (or its
+   absence) is a number, not an argument.
+
+Prints one JSON line; the ladder (scripts/tpu_ladder.py) banks it into
+artifacts/TPU_PROFILE.json as the ``layout_probe`` rung.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timed(fn, *args, iters: int = 50):
+    import jax
+
+    out = fn(*args)                       # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    platform = resolve_platform(pin=args.platform)
+
+    import jax
+    import jax.numpy as jnp
+
+    n = args.n
+    dev = jax.devices()[0]
+
+    def held_bytes():
+        stats = dev.memory_stats() or {}
+        return stats.get("bytes_in_use")
+
+    alloc = {}
+    for s in (16, 128):
+        base = held_bytes()
+        x = jnp.ones((n, s), jnp.uint32)
+        jax.block_until_ready(x)
+        after = held_bytes()
+        alloc[f"s{s}_logical_mb"] = round(n * s * 4 / 1e6, 1)
+        alloc[f"s{s}_held_mb"] = (round((after - base) / 1e6, 1)
+                                  if base is not None and after is not None
+                                  else None)
+        del x
+
+    s, f = 16, 8
+    r = jnp.asarray(12345, jnp.int32)
+    s1 = jnp.asarray(7, jnp.int32)
+
+    @jax.jit
+    def gossip_op_padded(mail, payload, r, s1):
+        # The ring inner op on the natural [N, 16] layout.
+        return jnp.maximum(mail, jnp.roll(jnp.roll(payload, r, axis=0),
+                                          s1, axis=1))
+
+    @jax.jit
+    def gossip_op_folded(mail, payload, r, s1):
+        # Same op on [N/8, 128]: node roll decomposes into an aligned
+        # sublane roll (r // f) plus a carry-select lane roll ((r % f)*s);
+        # the slot roll is a segment-wise lane roll (two rolls + select).
+        rq, rr = r // f, (r % f) * s
+        a = jnp.roll(payload, rq, axis=0)
+        b = jnp.roll(a, 1, axis=0)               # a rolled one more row
+        lane = jax.lax.broadcasted_iota(jnp.int32, payload.shape, 1)
+        rolled = jnp.where(lane < rr, jnp.roll(b, rr, axis=1),
+                           jnp.roll(a, rr, axis=1))
+        pos = lane % s
+        seg1 = jnp.roll(rolled, s1, axis=1)
+        seg2 = jnp.roll(rolled, s1 - s, axis=1)
+        aligned = jnp.where(pos < s1, seg2, seg1)
+        return jnp.maximum(mail, aligned)
+
+    key = jax.random.PRNGKey(0)
+    pay = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)
+    mail = jnp.zeros((n, s), jnp.uint32)
+    t_padded = _timed(gossip_op_padded, mail, pay, r, s1)
+
+    pay_f = pay.reshape(n // f, f * s)
+    mail_f = mail.reshape(n // f, f * s)
+    t_folded = _timed(gossip_op_folded, mail_f, pay_f, r, s1)
+
+    logical_gb = 3 * n * s * 4 / 1e9      # payload read, mail read+write
+    rec = {
+        "probe": "layout_s16",
+        "platform": jax.default_backend(),
+        "n": n,
+        "timing": "warm_cache",
+        **alloc,
+        "padded_ms": round(t_padded * 1e3, 3),
+        "folded_ms": round(t_folded * 1e3, 3),
+        "folded_speedup": round(t_padded / t_folded, 2),
+        "padded_eff_gbps": round(logical_gb / t_padded, 1),
+        "folded_eff_gbps": round(logical_gb / t_folded, 1),
+    }
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
