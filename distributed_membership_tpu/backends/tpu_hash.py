@@ -169,6 +169,8 @@ class HashConfig:
     fused_gossip: bool = False   # all circulant shifts delivered in one
     #                              Pallas traversal (ops/fused_gossip)
     #                              instead of fanout roll+max passes
+    folded: bool = False         # [N/F, 128] folded physical layout for
+    #                              S < 128 (backends/tpu_hash_folded.py)
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -795,6 +797,29 @@ def make_config(params: Params, collect_events: bool = True,
             "FUSED_GOSSIP requires a drop-free config (the jnp path "
             "draws a fresh per-shift drop mask the kernel cannot "
             "replicate bit-exactly)")
+    folded = bool(params.FOLDED)
+    if folded:
+        from distributed_membership_tpu.backends.tpu_hash_folded import (
+            folded_supported)
+        if exchange != "ring" or params.JOIN_MODE != "warm":
+            raise ValueError(
+                "FOLDED requires EXCHANGE ring and JOIN_MODE warm")
+        if collect_events:
+            raise ValueError(
+                "FOLDED requires aggregate events (EVENT_MODE agg)")
+        if fused or fused_g:
+            raise ValueError(
+                "FOLDED and the FUSED_* Pallas kernels are mutually "
+                "exclusive (the kernels assume the natural layout)")
+        if not folded_supported(n, s, params.PROBES):
+            raise ValueError(
+                f"FOLDED needs 0 < VIEW_SIZE < 128 dividing 128, N a "
+                f"multiple of 128/VIEW_SIZE, and PROBES dividing 128 "
+                f"(got N={n}, S={s}, P={params.PROBES})")
+        if not fast_agg:
+            raise ValueError(
+                "FOLDED requires the FastAgg event path (a static failed "
+                f"set of at most {FAST_AGG_MAX_FAILED} ids)")
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,
@@ -804,7 +829,7 @@ def make_config(params: Params, collect_events: bool = True,
         fail_ids=tuple(fail_ids) if fast_agg else (),
         fast_agg=fast_agg,
         count_probe_io=n <= PROBE_IO_EXACT_MAX,
-        fused_receive=fused, fused_gossip=fused_g)
+        fused_receive=fused, fused_gossip=fused_g, folded=folded)
 
 
 _RUNNER_CACHE: dict = {}
@@ -813,12 +838,19 @@ _RUNNER_CACHE: dict = {}
 def _get_runner(cfg: HashConfig, warm: bool):
     cache_key = (cfg, warm)
     if cache_key not in _RUNNER_CACHE:
-        step = make_step(cfg)
+        if cfg.folded:
+            from distributed_membership_tpu.backends.tpu_hash_folded import (
+                init_state_warm_folded, make_folded_step)
+            step = make_folded_step(cfg)
+            init = lambda warm_key: init_state_warm_folded(cfg, warm_key)  # noqa: E731
+        else:
+            step = make_step(cfg)
+            init = lambda warm_key: (init_state_warm(cfg, warm_key) if warm  # noqa: E731
+                                     else init_state(cfg))
 
         def run(keys, ticks, start_ticks, fail_mask, fail_time,
                 drop_lo, drop_hi, warm_key):
-            state0 = (init_state_warm(cfg, warm_key) if warm
-                      else init_state(cfg))
+            state0 = init(warm_key)
 
             def body(state, inp):
                 t, k = inp

@@ -1,0 +1,361 @@
+"""Folded-layout ring step: ``[N/F, 128]`` physical state for S < 128.
+
+**Why.**  TPU tiles the minormost array axis to 128 lanes (pallas guide
+"Tiling Constraints"), so a ``[N, 16]`` u32 plane is stored — and every
+pass streams — 8x its logical size.  The S=16 north-star regime
+(PERF.md) therefore runs at ~1/8 effective HBM efficiency on the natural
+layout.  This module re-expresses the single-chip ring step
+(backends/tpu_hash.py make_step, 'ring' branch) on a *folded* layout:
+``F = 128 // S`` nodes share each physical row, every plane is
+``[N/F, 128]`` — zero lane padding — and per-node structure lives in
+lane arithmetic (``node = row*F + lane//S``, ``slot = lane % S``).
+Probe state folds at its own factor (``FP = 128 // P``).
+
+**Bit-exactness.**  The folded step reproduces the unfolded ring run
+EXACTLY (same seed -> same trajectory): every jax.random draw keeps the
+unfolded call's key and flat element count (same-size shapes produce
+identical flat bit streams — pinned by tests), and every tensor op is
+the fold of the unfolded op:
+
+* node-axis roll by ``r`` decomposes into an aligned row roll
+  (``r // F``) plus a carry-select lane roll (``(r % F) * S``);
+* slot-axis roll by ``c`` becomes a segment-wise lane roll (two lane
+  rolls + a lane-position select);
+* per-node reductions are ``reshape(NF, F, S)`` reduces; per-node
+  vectors broadcast by lane-group repeat.
+
+Both decompositions are verified element-for-element against the padded
+ops (tests/test_folded.py; scripts/tpu_layout_probe.py times them on
+hardware).  Scope (enforced in tpu_hash.make_config): ring exchange,
+warm join, aggregate events with the FastAgg path, ``128 % S == 0``,
+``N % F == 0``, and ``128 % P == 0`` when probing.  Cold joins, full
+event collection, and the scatter exchange keep the natural layout.
+
+Reference lineage: the step semantics are tpu_hash's, which replicate
+/root/reference/MP1Node.cpp:404-495 (nodeLoopOps) + EmulNet delivery —
+see the tpu_hash module docstring for the mapping.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_membership_tpu.backends.tpu_sparse import SparseTickEvents
+from distributed_membership_tpu.observability.aggregates import (
+    init_fast_agg, update_fast_agg)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+EMPTY = -1
+LANES = 128
+
+
+def folded_supported(n: int, s: int, probes: int) -> bool:
+    # probes < s mirrors make_step's ring guard (the probe window is a
+    # cyclic band of the node's own S slots); the folded runner never
+    # reaches that guard, so it must hold here.
+    return (0 < s < LANES and LANES % s == 0 and n % (LANES // s) == 0
+            and (probes <= 0 or (probes < s and LANES % probes == 0
+                                 and n % (LANES // probes) == 0)))
+
+
+def roll_nodes(x: jax.Array, r: jax.Array, f: int, s: int) -> jax.Array:
+    """Fold of ``jnp.roll(unfolded, r, axis=0)`` (node-axis circulant).
+
+    Flat shift is ``r*S = (r//F)*128 + (r%F)*S``: an aligned row roll
+    plus a lane roll whose wrapped lanes take the once-more-rolled row.
+    """
+    rq = r // f
+    rr = (r % f) * s
+    a = jnp.roll(x, rq, axis=0)
+    b = jnp.roll(a, 1, axis=0)
+    lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
+    return jnp.where(lane < rr, jnp.roll(b, rr, axis=1),
+                     jnp.roll(a, rr, axis=1))
+
+
+def roll_slots(x: jax.Array, c: jax.Array, s: int) -> jax.Array:
+    """Fold of ``jnp.roll(unfolded, c, axis=1)`` (per-node slot roll):
+    a segment-wise lane roll, c in [0, s)."""
+    lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
+    pos = jax.lax.rem(lane, s)
+    return jnp.where(pos < c, jnp.roll(x, c - s, axis=1),
+                     jnp.roll(x, c, axis=1))
+
+
+def make_folded_step(cfg):
+    """Per-tick transition on folded state.  Mirrors make_step's ring
+    branch (tpu_hash.py) op for op; the warm-inert join machinery is
+    omitted (proven no-op under JOIN_MODE warm, which the config gate
+    requires)."""
+    from distributed_membership_tpu.backends.tpu_hash import (
+        STRIDE, HashConfig)
+    assert isinstance(cfg, HashConfig) and cfg.exchange == "ring"
+    n, s, g, p_cnt = cfg.n, cfg.s, cfg.g, cfg.probes
+    f = LANES // s
+    nf = n // f
+    k_max = min(cfg.fanout, s)
+    use_drop = cfg.drop_prob > 0.0
+    p_red = 1 if cfg.qp >= n else 2
+    cstride = STRIDE % s
+    single_col_roll = (n * STRIDE) % s == 0
+    idx = jnp.arange(n, dtype=I32)
+
+    # Static per-element coordinates of the big plane.
+    lane = jax.lax.broadcasted_iota(I32, (nf, LANES), 1)
+    row = jax.lax.broadcasted_iota(I32, (nf, LANES), 0)
+    pos = jax.lax.rem(lane, s)                       # slot within node
+    node = row * f + lane // s                       # global node id
+    self_slot = jax.lax.rem(
+        jax.lax.rem(node, s) * ((1 + STRIDE) % s), s)
+    self_mask = pos == self_slot
+
+    if p_cnt > 0:
+        fp = LANES // p_cnt
+        nfp = n // fp
+        lane_p = jax.lax.broadcasted_iota(I32, (nfp, LANES), 1)
+        row_p = jax.lax.broadcasted_iota(I32, (nfp, LANES), 0)
+        node_p = row_p * fp + lane_p // p_cnt        # node per probe elem
+        # Static gather maps between the two fold factors (small arrays:
+        # N*P elements).  window_idx: S-folded flat -> P-folded layout;
+        # cand_idx: P-folded flat (or the trailing zero) -> S-folded.
+        nd = np.arange(n)[:, None]
+        j = np.arange(p_cnt)[None, :]
+        window_idx = jnp.asarray(
+            (nd * s + j).reshape(nfp, LANES), I32)
+        q = np.arange(s)[None, :]
+        cand_src = np.where(q < p_cnt, np.arange(n)[:, None] * p_cnt + q,
+                            n * p_cnt)
+        cand_idx = jnp.asarray(cand_src.reshape(nf, LANES), I32)
+
+    def rep(v):
+        """[N] per-node vector -> [NF, 128] per-element broadcast."""
+        return jnp.repeat(v.reshape(nf, f), s, axis=1, total_repeat_length=LANES)
+
+    def rowsum(x):
+        return x.reshape(nf, f, s).sum(-1).reshape(n)
+
+    def rowany(x):
+        return x.reshape(nf, f, s).any(-1).reshape(n)
+
+    def step(state, inputs):
+        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        (k_targets, k_entries, k_drop, k_ctrl, k_drop_p, k_shifts,
+         k_ack1, k_ack2) = jax.random.split(key, 8)
+        p_drop = cfg.drop_prob
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+
+        recv_mask = state.started & (t > start_ticks) & ~state.failed
+        rcol = rep(recv_mask)
+
+        # ---- ack candidates (gather pipeline, P-folded) ----
+        ack_recv_cnt = jnp.zeros((n,), I32)
+        cand_sf = jnp.zeros((nf, LANES), U32)
+        if p_cnt > 0:
+            ids2 = state.probe_ids2                      # [NFP, 128] u32
+            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+            vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            hb_ack = vec[id2]
+            valid2 = (ids2 > 0) & (hb_ack > 0)
+            if use_drop:
+                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                valid2 &= ~(jax.random.bernoulli(k_ack2, p_drop,
+                                                 ids2.shape) & da_ack)
+            cand = jnp.where(
+                valid2,
+                hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
+            ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+            cand_ext = jnp.concatenate(
+                [cand.reshape(-1), jnp.zeros((1,), U32)])
+            cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
+            ack_recv_cnt = (
+                valid2 & jnp.repeat(recv_mask.reshape(nfp, fp), p_cnt,
+                                    axis=1, total_repeat_length=LANES)
+            ).reshape(nfp, fp, p_cnt).sum(-1).reshape(n).astype(I32)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        # ---- self refresh (warm: join machinery is inert, omitted) ----
+        act = recv_mask & state.in_group
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
+            + idx.astype(U32) + U32(1)
+
+        # ---- receive: admit + ack + self + sweep (folded receive_core) --
+        view, view_ts, mail = state.view, state.view_ts, state.mail
+        in_id = ((mail - U32(1)) % U32(n)).astype(I32)
+        occupied = view > 0
+        matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+        ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
+        take = (mail > 0) & ok
+        admitted = jnp.where(take, jnp.maximum(view, mail), view)
+        new_view = jnp.where(rcol, admitted, view)
+        changed = new_view > view
+        new_ts = jnp.where(changed, t, view_ts)
+        join_mask = changed & ~occupied
+        mail = jnp.where(rcol, U32(0), mail)
+
+        c_id = ((cand_sf - U32(1)) % U32(n)).astype(I32)
+        v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
+        match = (cand_sf > 0) & (new_view > 0) & (c_id == v_id) & rcol
+        upd = match & (cand_sf > new_view)
+        new_view = jnp.where(upd, cand_sf, new_view)
+        new_ts = jnp.where(upd, t, new_ts)
+
+        s_on = self_mask & rep(act)
+        new_view = jnp.where(s_on, rep(self_val), new_view)
+        new_ts = jnp.where(s_on, t, new_ts)
+
+        present = new_view > 0
+        difft = t - new_ts
+        stale = present & (difft >= cfg.tfail) & rep(act)
+        numfailed = rowsum(stale.astype(I32))
+        removes = stale & (difft >= cfg.tremove)
+        cur_id = jnp.where(present,
+                           ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
+        rm_ids = jnp.where(removes, cur_id, EMPTY)
+        new_view = jnp.where(removes, U32(0), new_view)
+        view, view_ts = new_view, new_ts
+        present = view > 0
+        cur_id = jnp.where(present, cur_id, EMPTY)
+        size = rowsum(present.astype(I32))
+        difft = t - view_ts
+
+        # ---- gossip: circulant shifts in folded space ----
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = cur_id == node
+        k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
+
+        if g >= s:
+            keep = fresh
+        else:
+            fresh_cnt = rowsum(fresh.astype(I32))
+            p_keep = jnp.where(
+                fresh_cnt > 1,
+                (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
+                1.0)
+            u = jax.random.uniform(k_entries, (nf, LANES))
+            keep = fresh & ((u < rep(p_keep)) | is_self_slot)
+        keep = keep & rep(act)
+        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        sent_gossip = jnp.zeros((n,), I32)
+        recv_add = jnp.zeros((n,), I32)
+        for jshift in range(k_max):
+            m = keep & rep(jshift < k_eff)
+            if use_drop:
+                m = m & ~(jax.random.bernoulli(
+                    jax.random.fold_in(k_drop, jshift), p_drop,
+                    (nf, LANES)) & drop_active)
+            r = shifts[jshift]
+            payload = jnp.where(m, view, U32(0))
+            rolled = roll_nodes(payload, r, f, s)
+            s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+            r1 = roll_slots(rolled, s1, s)
+            if single_col_roll:
+                delivered = r1
+            else:
+                s2 = jax.lax.rem(
+                    jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s)
+                r2 = roll_slots(rolled, s2, s)
+                delivered = jnp.where(rep((idx >= r)), r1, r2)
+            mail = jnp.maximum(mail, delivered)
+            cnt = rowsum(m.astype(I32))
+            sent_gossip = sent_gossip + cnt
+            recv_add = recv_add + jnp.roll(cnt, r)
+        sent_tick = sent_gossip
+
+        # ---- SWIM probes (P-folded) ----
+        probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
+        act_prev = state.act_prev
+        if p_cnt > 0:
+            ptr = jax.lax.rem(t * p_cnt, s)
+            rolled_w = roll_slots(view, (s - ptr) % s, s)
+            window = rolled_w.reshape(-1)[window_idx]      # [NFP, 128] u32
+            w_pres = window > 0
+            w_id = ((window - U32(1)) % U32(n)).astype(I32)
+            p_valid = w_pres & (w_id != node_p) & jnp.repeat(
+                act.reshape(nfp, fp), p_cnt, axis=1,
+                total_repeat_length=LANES)
+            if use_drop:
+                p_valid = p_valid & ~(jax.random.bernoulli(
+                    k_ack1, p_drop, p_valid.shape) & drop_active)
+            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+            probe_ids2, probe_ids1 = probe_ids1, ids_new
+            act_prev = act
+            psum_row = (lambda x: x.reshape(nfp, fp, p_cnt)
+                        .sum(-1).reshape(n))
+            sent_probes = psum_row(p_valid.astype(I32)) * p_red
+
+            ids1 = state.probe_ids1
+            v1 = ids1 > 0
+            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+            if cfg.count_probe_io:
+                ack_send = v1 & act[tgt1]
+                recv_probe = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(v1, tgt1, n).reshape(-1)].add(
+                        p_red, mode="drop")[:n]
+                sent_ack = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(ack_send, tgt1, n).reshape(-1)].add(
+                        1, mode="drop")[:n]
+            else:
+                in_flight = psum_row(v1.astype(I32))
+                recv_probe = in_flight * p_red
+                sent_ack = in_flight
+            sent_tick = sent_tick + sent_probes + sent_ack
+            recv_add = recv_add + recv_probe + ack_recv_cnt
+
+        pending_recv = pending_recv + recv_add
+        failed = state.failed | (fail_mask & (t == fail_time))
+
+        agg = update_fast_agg(
+            state.agg, t=t, fail_ids=cfg.fail_ids,
+            join_events=join_mask, rm_ids=rm_ids,
+            view_ids=cur_id, view_present=present,
+            fail_time=fail_time, holder_failed=fail_mask,
+            sent_tick=sent_tick, recv_tick=recv_tick,
+            row_any=rowany, row_expand=rep)
+        out = SparseTickEvents(join_mask.sum(dtype=I32),
+                               (rm_ids != EMPTY).sum(dtype=I32),
+                               sent_tick.sum(dtype=I32),
+                               recv_tick.sum(dtype=I32))
+
+        from distributed_membership_tpu.backends.tpu_hash import HashState
+        new_state = HashState(view, view_ts, state.started, state.in_group,
+                              failed, self_hb, mail, state.amail,
+                              state.pmail, state.joinreq_infl,
+                              state.joinrep_infl, pending_recv, agg,
+                              probe_ids1, probe_ids2, act_prev)
+        return new_state, out
+
+    return step
+
+
+def init_state_warm_folded(cfg, key: jax.Array):
+    """Fold of tpu_hash.init_state_warm: identical content, folded shapes
+    (a pure reshape of the unfolded warm state — one-time relayout)."""
+    from distributed_membership_tpu.backends.tpu_hash import (
+        HashState, init_state_warm)
+    st = init_state_warm(cfg, key)
+    f = LANES // cfg.s
+    nf = cfg.n // f
+    probe_shape = ((cfg.n // (LANES // cfg.probes), LANES)
+                   if cfg.probes > 0 else (1, 1))
+    return HashState(
+        view=st.view.reshape(nf, LANES),
+        view_ts=st.view_ts.reshape(nf, LANES),
+        started=st.started, in_group=st.in_group, failed=st.failed,
+        self_hb=st.self_hb,
+        mail=st.mail.reshape(nf, LANES),
+        amail=st.amail, pmail=st.pmail,
+        joinreq_infl=st.joinreq_infl, joinrep_infl=st.joinrep_infl,
+        pending_recv=st.pending_recv,
+        agg=init_fast_agg(len(cfg.fail_ids), cfg.n),
+        probe_ids1=jnp.zeros(probe_shape, U32),
+        probe_ids2=jnp.zeros(probe_shape, U32),
+        act_prev=jnp.zeros((cfg.n,), bool),
+    )

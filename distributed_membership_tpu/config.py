@@ -112,6 +112,12 @@ class Params:
     # Requires EXCHANGE ring, VIEW_SIZE % 128 == 0, N a multiple of the
     # view size ((N*STRIDE) % S == 0), and a drop-free config.
     FUSED_GOSSIP: int = 0
+    # Folded [N/F, 128] physical layout for VIEW_SIZE < 128 (F = 128/S):
+    # removes the 128-lane padding that costs the S=16 regime ~8x HBM on
+    # TPU (backends/tpu_hash_folded.py).  Requires EXCHANGE ring,
+    # JOIN_MODE warm, aggregate events, 128 % VIEW_SIZE == 0.  Bit-exact
+    # with the natural layout (same seed -> same trajectory).
+    FOLDED: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""

@@ -195,39 +195,50 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
                     join_events: jax.Array, rm_ids: jax.Array,
                     view_ids: jax.Array, view_present: jax.Array,
                     fail_time: jax.Array, holder_failed: jax.Array,
-                    sent_tick: jax.Array, recv_tick: jax.Array) -> FastAgg:
+                    sent_tick: jax.Array, recv_tick: jax.Array,
+                    row_any=None, row_expand=None) -> FastAgg:
     """One tick, all elementwise/reduce (``fail_ids`` is a STATIC tuple).
 
     ``join_events``: [rows, M] bool (admissions this tick); ``rm_ids``:
     [rows, M] member ids (EMPTY = none); ``holder_failed``: [rows] bool
     crash mask aligned to observer rows (a sharded caller passes its local
-    slice).
+    slice).  ``row_any`` / ``row_expand`` map between the event plane and
+    per-observer [rows] vectors — default to ``any(axis=1)`` /
+    ``v[:, None]`` for the natural [rows, M] layout; the folded layout
+    passes its segment-aware pair (backends/tpu_hash_folded.py).
     """
     rm_mask = rm_ids >= 0
     post = t > fail_time
+    if row_any is None:
+        def row_any(m):
+            return m.any(axis=1)
+    if row_expand is None:
+        def row_expand(v):
+            return v[:, None]
+    n_obs = holder_failed.shape[0]
 
     if fail_ids:
         per_f_rm = [rm_mask & (rm_ids == f) for f in fail_ids]
         det_tick = jnp.stack(
             [m.sum(dtype=I32) for m in per_f_rm]) * post.astype(I32)
-        any_true_rm = jnp.zeros(rm_ids.shape[:1], bool)
+        any_true_rm = jnp.zeros((n_obs,), bool)
         for m in per_f_rm:
-            any_true_rm = any_true_rm | m.any(axis=1)
+            any_true_rm = any_true_rm | row_any(m)
 
         def census():
-            live = ~holder_failed[:, None]
+            live = ~row_expand(holder_failed)
             tr = jnp.stack([(view_present & (view_ids == f) & live)
                             .sum(dtype=I32) for f in fail_ids])
-            holds = jnp.zeros(view_ids.shape[:1], bool)
+            holds = jnp.zeros((n_obs,), bool)
             for f in fail_ids:
-                holds = holds | (view_present & (view_ids == f)).any(axis=1)
+                holds = holds | row_any(view_present & (view_ids == f))
             return tr, holds & ~holder_failed
 
         trackers, tracker_obs = jax.lax.cond(
             t == fail_time, census, lambda: (agg.trackers, agg.tracker_obs))
     else:
         det_tick = jnp.zeros_like(agg.det_count)
-        any_true_rm = jnp.zeros(rm_ids.shape[:1], bool)
+        any_true_rm = jnp.zeros((n_obs,), bool)
         trackers, tracker_obs = agg.trackers, agg.tracker_obs
 
     lat = jnp.clip(t - fail_time, 0, LAT_BINS - 1)

@@ -37,7 +37,7 @@ def _example_scan_args(params, plan, ticks):
 
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                fanout: int = 3, cost: bool = False,
-               fused_gossip: bool = False) -> dict:
+               fused_gossip: bool = False, folded: bool = False) -> dict:
     import random as _pyrandom
 
     import jax
@@ -55,7 +55,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
-        f"FUSED_GOSSIP: {int(fused_gossip)}\n"
+        f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
         f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
 
@@ -79,7 +79,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     # Ring roofline passes (PERF.md): receive ~12 jnp / ~6 fused, gossip
     # ~3 per shift, probe/agg ~4.
     state_bytes = 3 * n * s * 4
-    gossip_passes = ((2 * min(cfg.fanout, cfg.s) + 2 + 2) if fused_gossip
+    gossip_passes = ((2 * min(cfg.fanout, cfg.s) + 2) if fused_gossip
                      else 3 * min(cfg.fanout, cfg.s))
     passes = (6 if fused else 12) + gossip_passes + 4
     est_gb_per_tick = passes * (n * s * 4) / 1e9
@@ -111,7 +111,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
             measured = {"cost_analysis_error": repr(e)[:120]}
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
-        "fused": fused, "fused_gossip": fused_gossip,
+        "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
         "fanout": cfg.fanout, "probes": cfg.probes,
         "platform": jax.default_backend(),
         # wall_seconds is a SECOND run on the warm jit cache; compile time
@@ -141,6 +141,7 @@ def main() -> int:
     ap.add_argument("--fanout", type=int, default=3)
     ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
     ap.add_argument("--fused-gossip", default="off", choices=["off", "on"])
+    ap.add_argument("--folded", default="off", choices=["off", "on"])
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
@@ -157,7 +158,8 @@ def main() -> int:
         for fused in fused_opts:
             rec = time_point(n, args.view, args.ticks, args.exchange,
                              fused, args.fanout, cost=args.cost,
-                             fused_gossip=args.fused_gossip == "on")
+                             fused_gossip=args.fused_gossip == "on",
+                             folded=args.folded == "on")
             print(json.dumps(rec), flush=True)
     return 0
 

@@ -35,13 +35,14 @@ sys.path.insert(0, REPO)
 
 OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 
-# (name, n, view, ticks, fused_mode, timeout_s) — smallest first; timeouts
-# sized ~4x the expected wall so a hung relay is cut quickly.  fused_mode:
+# (name, n, view, ticks, mode, timeout_s) — smallest first; timeouts
+# sized ~4x the expected wall so a hung relay is cut quickly.  mode:
 # 'off' | 'recv' (Pallas receive kernel) | 'gossip' (Pallas gossip
-# delivery) | 'both'.  The special first rung runs
-# scripts/tpu_correctness.py (fused-vs-jnp bit-equality for BOTH kernels
-# on the real Mosaic lowering — 5 scans) instead of a timing point; a
-# failure there gates every fused timing rung off.
+# delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128 —
+# no Pallas, so not gated by the correctness rung).  The special first
+# rung runs scripts/tpu_correctness.py (fused-vs-jnp bit-equality for
+# both Pallas kernels on the real Mosaic lowering — 5 scans) instead of
+# a timing point; a failure there gates the Pallas timing rungs off.
 CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
 # Cheap hardware probe of the S<128 lane-padding premise (PERF.md) —
 # memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
@@ -58,7 +59,10 @@ LADDER = [
     ("262k_s64",         1 << 18,  64,  60, "off",    420),
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
+    ("1M_s16_folded",    1 << 20,  16,  60, "folded", 600),
+    ("65k_s16_folded",   1 << 16,  16, 150, "folded", 240),
     ("524k_s64",         1 << 19,  64,  60, "off",    600),
+    ("1M_s64_folded",    1 << 20,  64,  60, "folded", 900),
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
     ("1M_s128",          1 << 20, 128,  40, "off",    900),
     ("1M_s128_fboth",    1 << 20, 128,  40, "both",   900),
@@ -113,7 +117,8 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
                "--fused", "on" if fused in ("recv", "both") else "off",
                "--fused-gossip",
-               "on" if fused in ("gossip", "both") else "off"]
+               "on" if fused in ("gossip", "both") else "off",
+               "--folded", "on" if fused == "folded" else "off"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True, env=env, cwd=REPO)
@@ -158,10 +163,11 @@ def _missing() -> list:
     # kernel that miscompiles on Mosaic must not contribute perf evidence.
     corr = done.get(CORRECTNESS_RUNG[0])
     fused_ok = corr is None or corr.get("ok", False)
+    pallas = ("recv", "gossip", "both")
     return [r for r in LADDER
             if r[0] not in done
-            and not (r[4] != "off" and r[2] % 128 != 0)
-            and not (r[4] != "off" and not fused_ok)]
+            and not (r[4] in pallas and r[2] % 128 != 0)
+            and not (r[4] in pallas and not fused_ok)]
 
 
 def one_pass() -> tuple[int, int]:
@@ -193,9 +199,10 @@ def one_pass() -> tuple[int, int]:
         append(rec)
         landed += 1
         if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
-            # Gate fused timing rungs off THIS pass too, not just the next
-            # (_missing() only sees the failure on re-read).
-            pending = [r for r in pending if r[4] == "off"]
+            # Gate Pallas timing rungs off THIS pass too, not just the
+            # next (_missing() only sees the failure on re-read).
+            pending = [r for r in pending
+                       if r[4] not in ("recv", "gossip", "both")]
         if "node_ticks_per_sec" in rec:
             print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
                   f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)

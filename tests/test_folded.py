@@ -1,0 +1,112 @@
+"""Folded [N/F, 128] layout == the natural [N, S] ring path, bit-exact.
+
+The folded step (backends/tpu_hash_folded.py) exists to remove the
+128-lane padding tax on S < 128 TPU state; its contract is that the
+ENTIRE trajectory — views, timestamps, mailboxes, the probe/ack
+pipeline, message counters, FastAgg aggregates, per-tick event scalars —
+is the fold of the natural layout's, same seed, tick for tick.  These
+tests pin the two roll decompositions element-for-element and the
+end-to-end equality with and without message drops.
+"""
+
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.backends.tpu_hash import run_scan
+from distributed_membership_tpu.backends.tpu_hash_folded import (
+    folded_supported, roll_nodes, roll_slots)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.runtime.failures import make_plan
+
+
+@pytest.mark.parametrize("n,s", [(256, 16), (128, 32), (512, 64)])
+def test_roll_decompositions(n, s):
+    f = 128 // s
+    key = jax.random.PRNGKey(n + s)
+    x = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)
+    xf = x.reshape(n // f, 128)
+    for r in (1, f - 1, f, f + 1, n // 2, n - 1):
+        want = jnp.roll(x, r, axis=0).reshape(n // f, 128)
+        got = roll_nodes(xf, jnp.asarray(r), f, s)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
+                                      err_msg=f"roll_nodes r={r}")
+    for c in (0, 1, s // 2, s - 1):
+        want = jnp.roll(x, c, axis=1).reshape(n // f, 128)
+        got = roll_slots(xf, jnp.asarray(c), s)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
+                                      err_msg=f"roll_slots c={c}")
+
+
+def _run(folded: int, drop: bool):
+    dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\nDROP_STOP: 90\n"
+          if drop else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    p = Params.from_text(
+        f"MAX_NNB: 512\nSINGLE_FAILURE: 1\n{dk}"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 4\nPROBES: 2\nFANOUT: 3\nTFAIL: 16\n"
+        "TREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\nJOIN_MODE: warm\n"
+        f"EVENT_MODE: agg\nEXCHANGE: ring\nFOLDED: {folded}\n"
+        "BACKEND: tpu_hash\n")
+    plan = make_plan(p, random.Random("app:0"))
+    return run_scan(p, plan, seed=0, collect_events=False)
+
+
+@pytest.mark.parametrize("drop", [False, True])
+def test_folded_run_bit_exact(drop):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # small TREMOVE under loss is fine
+        f0, e0 = _run(0, drop)
+        f1, e1 = _run(1, drop)
+    for name in ("view", "view_ts", "mail", "probe_ids1", "probe_ids2"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f0, name)).reshape(-1),
+            np.asarray(getattr(f1, name)).reshape(-1), err_msg=name)
+    for name in ("self_hb", "pending_recv", "failed", "act_prev"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    for name in f0.agg._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(f0.agg, name)),
+                                      np.asarray(getattr(f1.agg, name)),
+                                      err_msg=f"agg.{name}")
+    for name in ("join_ids", "rm_ids", "sent", "recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(e0, name)),
+                                      np.asarray(getattr(e1, name)),
+                                      err_msg=name)
+
+
+def test_folded_support_predicate():
+    assert folded_supported(1 << 20, 16, 2)
+    assert folded_supported(1 << 16, 64, 8)
+    assert not folded_supported(1 << 16, 128, 8)    # no padding to remove
+    assert not folded_supported(100, 16, 2)         # N % F != 0
+    assert not folded_supported(1 << 16, 24, 2)     # 128 % S != 0
+
+
+def test_folded_rejects_unsupported_configs():
+    from distributed_membership_tpu.backends.tpu_hash import make_config
+
+    base = ("MAX_NNB: 512\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 4\nPROBES: 2\n"
+            "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\n"
+            "EVENT_MODE: agg\nFOLDED: 1\nBACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="JOIN_MODE warm"):
+        make_config(Params.from_text(base + "JOIN_MODE: batch\n"
+                                     "EXCHANGE: ring\n"),
+                    collect_events=False)
+    with pytest.raises(ValueError, match="aggregate events"):
+        make_config(Params.from_text(base + "JOIN_MODE: warm\n"
+                                     "EXCHANGE: ring\n"),
+                    collect_events=True)
+    # FOLDED + FUSED_* can never co-validate: fused needs S % 128 == 0,
+    # folded needs S < 128 — whichever check fires first, it raises.
+    with pytest.raises(ValueError):
+        make_config(Params.from_text(
+            base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 64")
+            + "JOIN_MODE: warm\nEXCHANGE: ring\nFUSED_RECEIVE: 1\n"),
+            collect_events=False)
