@@ -22,8 +22,9 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Env overrides: BENCH_N / BENCH_TICKS / BENCH_VIEW (hash leg; gossip len and
-probes derive from the view size), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg
-seconds).
+probes derive from the view size), BENCH_FUSED (off|recv|gossip|both —
+Pallas kernels), BENCH_FOLDED (on = the [N/F, 128] folded layout for
+S < 128), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg seconds).
 """
 
 from __future__ import annotations
@@ -82,8 +83,12 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     if fused not in ("off", "recv", "gossip", "both"):
         raise SystemExit(f"BENCH_FUSED must be off|recv|gossip|both, "
                          f"got {fused!r}")
+    folded = os.environ.get("BENCH_FOLDED", "off")
+    if folded not in ("off", "on"):
+        raise SystemExit(f"BENCH_FOLDED must be off|on, got {folded!r}")
     fused_keys = (f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
-                  f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n")
+                  f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n"
+                  f"FOLDED: {int(folded == 'on')}\n")
     params = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
@@ -116,7 +121,7 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
 
     return {
         "leg": "hash", "platform": platform, "n": n, "ticks": ticks,
-        "fused": fused,
+        "fused": fused, "folded": folded == "on",
         "node_ticks_per_sec": round(n * ticks / wall, 1),
         "wall_seconds": round(wall, 3),
         "ticks_per_sec": round(ticks / wall, 2),

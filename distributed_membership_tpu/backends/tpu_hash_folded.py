@@ -85,6 +85,55 @@ def roll_slots(x: jax.Array, c: jax.Array, s: int) -> jax.Array:
                      jnp.roll(x, c, axis=1))
 
 
+def _folded_receive(n, tfail, tremove, rep, rowsum, self_mask, node,
+                    t, view, view_ts, mail, cand_sf, rcol, act, self_val):
+    """The receive pass (admit + ack-merge + self-write + TFAIL/TREMOVE
+    sweep) on folded planes — the folded twin of
+    ops/fused_receive._receive_body, shared by the single-chip and
+    sharded folded steps so the two cannot drift.
+
+    Returns (view, view_ts, mail_cleared, join_mask, rm_ids, numfailed,
+    size, cur_id, present, difft)."""
+    in_id = ((mail - U32(1)) % U32(n)).astype(I32)
+    occupied = view > 0
+    matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+    ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
+    take = (mail > 0) & ok
+    admitted = jnp.where(take, jnp.maximum(view, mail), view)
+    new_view = jnp.where(rcol, admitted, view)
+    changed = new_view > view
+    new_ts = jnp.where(changed, t, view_ts)
+    join_mask = changed & ~occupied
+    mail = jnp.where(rcol, U32(0), mail)
+
+    c_id = ((cand_sf - U32(1)) % U32(n)).astype(I32)
+    v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
+    match = (cand_sf > 0) & (new_view > 0) & (c_id == v_id) & rcol
+    upd = match & (cand_sf > new_view)
+    new_view = jnp.where(upd, cand_sf, new_view)
+    new_ts = jnp.where(upd, t, new_ts)
+
+    s_on = self_mask & rep(act)
+    new_view = jnp.where(s_on, rep(self_val), new_view)
+    new_ts = jnp.where(s_on, t, new_ts)
+
+    present = new_view > 0
+    difft = t - new_ts
+    stale = present & (difft >= tfail) & rep(act)
+    numfailed = rowsum(stale.astype(I32))
+    removes = stale & (difft >= tremove)
+    cur_id = jnp.where(present,
+                       ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
+    rm_ids = jnp.where(removes, cur_id, EMPTY)
+    new_view = jnp.where(removes, U32(0), new_view)
+    present = new_view > 0
+    cur_id = jnp.where(present, cur_id, EMPTY)
+    size = rowsum(present.astype(I32))
+    difft = t - new_ts
+    return (new_view, new_ts, mail, join_mask, rm_ids, numfailed, size,
+            cur_id, present, difft)
+
+
 def make_folded_step(cfg):
     """Per-tick transition on folded state.  Mirrors make_step's ring
     branch (tpu_hash.py) op for op; the warm-inert join machinery is
@@ -185,45 +234,12 @@ def make_folded_step(cfg):
         self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
             + idx.astype(U32) + U32(1)
 
-        # ---- receive: admit + ack + self + sweep (folded receive_core) --
-        view, view_ts, mail = state.view, state.view_ts, state.mail
-        in_id = ((mail - U32(1)) % U32(n)).astype(I32)
-        occupied = view > 0
-        matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-        ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
-        take = (mail > 0) & ok
-        admitted = jnp.where(take, jnp.maximum(view, mail), view)
-        new_view = jnp.where(rcol, admitted, view)
-        changed = new_view > view
-        new_ts = jnp.where(changed, t, view_ts)
-        join_mask = changed & ~occupied
-        mail = jnp.where(rcol, U32(0), mail)
-
-        c_id = ((cand_sf - U32(1)) % U32(n)).astype(I32)
-        v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
-        match = (cand_sf > 0) & (new_view > 0) & (c_id == v_id) & rcol
-        upd = match & (cand_sf > new_view)
-        new_view = jnp.where(upd, cand_sf, new_view)
-        new_ts = jnp.where(upd, t, new_ts)
-
-        s_on = self_mask & rep(act)
-        new_view = jnp.where(s_on, rep(self_val), new_view)
-        new_ts = jnp.where(s_on, t, new_ts)
-
-        present = new_view > 0
-        difft = t - new_ts
-        stale = present & (difft >= cfg.tfail) & rep(act)
-        numfailed = rowsum(stale.astype(I32))
-        removes = stale & (difft >= cfg.tremove)
-        cur_id = jnp.where(present,
-                           ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
-        rm_ids = jnp.where(removes, cur_id, EMPTY)
-        new_view = jnp.where(removes, U32(0), new_view)
-        view, view_ts = new_view, new_ts
-        present = view > 0
-        cur_id = jnp.where(present, cur_id, EMPTY)
-        size = rowsum(present.astype(I32))
-        difft = t - view_ts
+        # ---- receive: admit + ack + self + sweep (shared folded core) --
+        (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
+         present, difft) = _folded_receive(
+            n, cfg.tfail, cfg.tremove, rep, rowsum, self_mask, node,
+            t, state.view, state.view_ts, state.mail, cand_sf, rcol, act,
+            self_val)
 
         # ---- gossip: circulant shifts in folded space ----
         numpotential = size - 1 - numfailed
@@ -333,6 +349,262 @@ def make_folded_step(cfg):
         return new_state, out
 
     return step
+
+
+def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
+    """Folded twin of make_ring_sharded_step's warm path
+    (tpu_hash_sharded.py): local planes are ``[L/F, 128]``, so the
+    per-shift ``ppermute`` moves 1/F the bytes over ICI as well as HBM.
+    Bit-exact with the natural sharded ring step at the same seed
+    (tests/test_folded.py); cold joins keep the natural layout (the
+    make_config gate requires JOIN_MODE warm for FOLDED)."""
+    from jax import lax
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        STRIDE, HashConfig)
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        NODE_AXIS, ShardedHashState)
+    assert isinstance(cfg, HashConfig) and cfg.exchange == "ring"
+    n, s, g, p_cnt = cfg.n, cfg.s, cfg.g, cfg.probes
+    f = LANES // s
+    lf = n_local // f
+    k_max = min(cfg.fanout, s)
+    use_drop = cfg.drop_prob > 0.0
+    p_red = 1 if cfg.qp >= n else 2
+    cstride = STRIDE % s
+    single_col_roll = (n_local * STRIDE) % s == 0
+    l_idx = jnp.arange(n_local, dtype=I32)
+
+    lane = jax.lax.broadcasted_iota(I32, (lf, LANES), 1)
+    row = jax.lax.broadcasted_iota(I32, (lf, LANES), 0)
+    pos = jax.lax.rem(lane, s)
+    local_node = row * f + lane // s                 # local row index
+
+    if p_cnt > 0:
+        fp = LANES // p_cnt
+        lfp = n_local // fp
+        lane_p = jax.lax.broadcasted_iota(I32, (lfp, LANES), 1)
+        row_p = jax.lax.broadcasted_iota(I32, (lfp, LANES), 0)
+        local_node_p = row_p * fp + lane_p // p_cnt
+        nd = np.arange(n_local)[:, None]
+        j = np.arange(p_cnt)[None, :]
+        window_idx = jnp.asarray((nd * s + j).reshape(lfp, LANES), I32)
+        q = np.arange(s)[None, :]
+        cand_src = np.where(q < p_cnt,
+                            np.arange(n_local)[:, None] * p_cnt + q,
+                            n_local * p_cnt)
+        cand_idx = jnp.asarray(cand_src.reshape(lf, LANES), I32)
+
+    def rep(v):
+        return jnp.repeat(v.reshape(lf, f), s, axis=1,
+                          total_repeat_length=LANES)
+
+    def rowsum(x):
+        return x.reshape(lf, f, s).sum(-1).reshape(n_local)
+
+    def rowany(x):
+        return x.reshape(lf, f, s).any(-1).reshape(n_local)
+
+    def block_send(tensors, b):
+        def mk(i):
+            if i == 0:
+                return lambda ops: ops
+            perm = [(src, (src + i) % n_shards) for src in range(n_shards)]
+            return lambda ops: tuple(
+                lax.ppermute(o, NODE_AXIS, perm) for o in ops)
+        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+
+    def step(state, inputs):
+        t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = \
+            inputs
+        me = lax.axis_index(NODE_AXIS)
+        row0 = (me * n_local).astype(I32)
+        lrows = row0 + l_idx
+        node = local_node + row0                     # global id / element
+        self_slot = jax.lax.rem(
+            jax.lax.rem(node, s) * ((1 + STRIDE) % s), s)
+        self_mask = pos == self_slot
+        fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
+        start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,),
+                                          (n_local,))
+        key_l = jax.random.fold_in(key, me)
+        k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(
+            key_l, 4)
+        k_shifts = jax.random.fold_in(key, 0x517F)
+        drop_active = (t > drop_lo) & (t <= drop_hi)
+
+        recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        rcol = rep(recv_mask)
+
+        # ---- ack candidates (gather pipeline, P-folded) ----
+        ack_recv_cnt = jnp.zeros((n_local,), I32)
+        cand_sf = jnp.zeros((lf, LANES), U32)
+        if p_cnt > 0:
+            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)    # [N]
+            ids2 = state.probe_ids2                  # [LFP, 128] u32
+            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+            hb_ack = vec_g[id2]
+            valid2 = (ids2 > 0) & (hb_ack > 0)
+            if use_drop:
+                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                valid2 &= ~(jax.random.bernoulli(k_ack2, cfg.drop_prob,
+                                                 ids2.shape) & da_ack)
+            cand = jnp.where(
+                valid2,
+                hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
+            ptr2 = lax.rem(lax.rem((t - 2) * p_cnt, s) + s, s)
+            cand_ext = jnp.concatenate(
+                [cand.reshape(-1), jnp.zeros((1,), U32)])
+            cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
+            ack_recv_cnt = (
+                valid2 & jnp.repeat(recv_mask.reshape(lfp, fp), p_cnt,
+                                    axis=1, total_repeat_length=LANES)
+            ).reshape(lfp, fp, p_cnt).sum(-1).reshape(n_local).astype(I32)
+
+        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
+        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+
+        # ---- self refresh (warm: join machinery inert) ----
+        act = recv_mask & state.in_group
+        own_hb = state.self_hb + 1
+        self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
+        self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
+            + lrows.astype(U32) + U32(1)
+
+        # ---- receive: admit + ack + self + sweep (shared folded core) --
+        (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
+         present, difft) = _folded_receive(
+            n, cfg.tfail, cfg.tremove, rep, rowsum, self_mask, node,
+            t, state.view, state.view_ts, state.mail, cand_sf, rcol, act,
+            self_val)
+
+        # ---- gossip: torus-product shifts, folded local planes ----
+        numpotential = size - 1 - numfailed
+        fresh = present & (difft < cfg.tfail)
+        is_self_slot = cur_id == node
+        k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
+        if g >= s:
+            keep = fresh
+        else:
+            fresh_cnt = rowsum(fresh.astype(I32))
+            p_keep = jnp.where(
+                fresh_cnt > 1,
+                (g - 1) / jnp.maximum(fresh_cnt - 1, 1)
+                .astype(jnp.float32), 1.0)
+            u_keep = jax.random.uniform(k_entries, (lf, LANES))
+            keep = fresh & ((u_keep < rep(p_keep)) | is_self_slot)
+        keep = keep & rep(act)
+
+        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        sent_gossip = jnp.zeros((n_local,), I32)
+        recv_add = jnp.zeros((n_local,), I32)
+        for jshift in range(k_max):
+            m = keep & rep(jshift < k_eff)
+            if use_drop:
+                m = m & ~(jax.random.bernoulli(
+                    jax.random.fold_in(k_dropg, jshift), cfg.drop_prob,
+                    (lf, LANES)) & drop_active)
+            payload = jnp.where(m, view, U32(0))
+            cnt = rowsum(m.astype(I32))
+            sent_gossip = sent_gossip + cnt
+            u = shifts[jshift]
+            b = u // n_local
+            c = lax.rem(u, n_local)
+            payload_r, cnt_r = block_send((payload, cnt), b)
+            payload_r = roll_nodes(payload_r, c, f, s)
+            cnt_r = jnp.roll(cnt_r, c, axis=0)
+            bp = jnp.where(me < b, b - n_shards, b)
+            base1 = lax.rem(lax.rem(bp * n_local + c, s) + s, s)
+            r1 = roll_slots(payload_r, lax.rem(base1 * cstride, s), s)
+            if single_col_roll:
+                result = r1
+            else:
+                base2 = lax.rem(
+                    lax.rem(bp * n_local + c - n_local, s) + s, s)
+                r2 = roll_slots(payload_r, lax.rem(base2 * cstride, s), s)
+                result = jnp.where(rep(l_idx >= c), r1, r2)
+            mail = jnp.maximum(mail, result)
+            recv_add = recv_add + cnt_r
+        sent_tick = sent_gossip
+
+        # ---- probe issue (P-folded; prober attribution, as natural) ----
+        probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
+        act_prev = state.act_prev
+        if p_cnt > 0:
+            ptr = lax.rem(t * p_cnt, s)
+            rolled_w = roll_slots(view, (s - ptr) % s, s)
+            window = rolled_w.reshape(-1)[window_idx]    # [LFP, 128]
+            w_pres = window > 0
+            w_id = ((window - U32(1)) % U32(n)).astype(I32)
+            node_p = local_node_p + row0
+            p_valid = w_pres & (w_id != node_p) & jnp.repeat(
+                act.reshape(lfp, fp), p_cnt, axis=1,
+                total_repeat_length=LANES)
+            if use_drop:
+                p_valid = p_valid & ~(jax.random.bernoulli(
+                    k_probe_drop, cfg.drop_prob, p_valid.shape)
+                    & drop_active)
+            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1),
+                                U32(0))
+            probe_ids2, probe_ids1 = probe_ids1, ids_new
+            act_prev = act
+            psum_row = (lambda x: x.reshape(lfp, fp, p_cnt)
+                        .sum(-1).reshape(n_local))
+            sent_probes = psum_row(p_valid.astype(I32)) * p_red
+            in_flight = psum_row((state.probe_ids1 > 0).astype(I32))
+            sent_tick = sent_tick + sent_probes + in_flight
+            recv_add = recv_add + in_flight * p_red + ack_recv_cnt
+
+        pending_recv = pending_recv + recv_add
+        failed = state.failed | (fail_mask_l & (t == fail_time))
+
+        agg = update_fast_agg(
+            state.agg, t=t, fail_ids=cfg.fail_ids,
+            join_events=join_mask, rm_ids=rm_ids,
+            view_ids=cur_id, view_present=present,
+            fail_time=fail_time, holder_failed=fail_mask_l,
+            sent_tick=sent_tick, recv_tick=recv_tick,
+            row_any=rowany, row_expand=rep)
+        out = SparseTickEvents(
+            lax.psum(join_mask.sum(dtype=I32), NODE_AXIS),
+            lax.psum((rm_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
+            lax.psum(sent_tick.sum(dtype=I32), NODE_AXIS),
+            lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
+
+        new_state = ShardedHashState(
+            view, view_ts, state.started, state.in_group, failed,
+            self_hb, mail, state.amail, state.pmail,
+            state.joinreq_infl, state.joinrep_infl, pending_recv, agg,
+            probe_ids1, probe_ids2, act_prev)
+        return new_state, out
+
+    return step
+
+
+def init_local_state_warm_folded(cfg, n_local: int, key: jax.Array):
+    """Fold of tpu_hash_sharded.init_local_state_warm (pure reshape)."""
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        ShardedHashState, init_local_state_warm)
+    st = init_local_state_warm(cfg, n_local, key)
+    f = LANES // cfg.s
+    lf = n_local // f
+    probe_shape = ((n_local // (LANES // cfg.probes), LANES)
+                   if cfg.probes > 0 else (1, 1))
+    return ShardedHashState(
+        view=st.view.reshape(lf, LANES),
+        view_ts=st.view_ts.reshape(lf, LANES),
+        started=st.started, in_group=st.in_group, failed=st.failed,
+        self_hb=st.self_hb,
+        mail=st.mail.reshape(lf, LANES),
+        amail=st.amail, pmail=st.pmail,
+        joinreq_infl=st.joinreq_infl, joinrep_infl=st.joinrep_infl,
+        pending_recv=st.pending_recv,
+        agg=init_fast_agg(len(cfg.fail_ids), n_local),
+        probe_ids1=jnp.zeros(probe_shape, U32),
+        probe_ids2=jnp.zeros(probe_shape, U32),
+        act_prev=jnp.zeros((n_local,), bool),
+    )
 
 
 def init_state_warm_folded(cfg, key: jax.Array):

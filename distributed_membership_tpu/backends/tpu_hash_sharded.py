@@ -901,14 +901,22 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     if cache_key not in _RUNNER_CACHE:
         n_shards = mesh.shape[NODE_AXIS]
         ring = cfg.exchange == "ring"
-        step = (make_ring_sharded_step(cfg, n_local, n_shards,
-                                       cold_join=not warm) if ring
-                else make_sharded_step(cfg, n_local, n_shards))
+        if cfg.folded:
+            from distributed_membership_tpu.backends.tpu_hash_folded import (
+                init_local_state_warm_folded, make_ring_sharded_folded_step)
+            step = make_ring_sharded_folded_step(cfg, n_local, n_shards)
+            init = lambda k: init_local_state_warm_folded(  # noqa: E731
+                cfg, n_local, k)
+        else:
+            step = (make_ring_sharded_step(cfg, n_local, n_shards,
+                                           cold_join=not warm) if ring
+                    else make_sharded_step(cfg, n_local, n_shards))
+            init = lambda k: (init_local_state_warm(cfg, n_local, k)  # noqa: E731
+                              if warm else init_local_state(cfg, n_local))
 
         def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
                       drop_lo, drop_hi, warm_key):
-            state0 = (init_local_state_warm(cfg, n_local, warm_key) if warm
-                      else init_local_state(cfg, n_local))
+            state0 = init(warm_key)
 
             def body(state, inp):
                 t, k = inp
@@ -957,6 +965,16 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     n_local = n // d
     fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
     cfg = make_config(params, collect_events, fail_ids=fail_ids)
+    if cfg.folded:
+        from distributed_membership_tpu.backends.tpu_hash_folded import (
+            folded_supported)
+        # make_config validated against global N; the folded planes are
+        # the per-shard LOCAL rows here.
+        if not folded_supported(n_local, cfg.s, cfg.probes):
+            raise ValueError(
+                f"FOLDED on tpu_hash_sharded needs the per-shard row "
+                f"count to fold (L={n_local}, S={cfg.s}, P={cfg.probes}: "
+                "L must be a multiple of 128/S and 128/P)")
     if cfg.fused_receive:
         # make_config validated against global N; the kernel runs over the
         # LOCAL rows here.

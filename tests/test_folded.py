@@ -110,3 +110,48 @@ def test_folded_rejects_unsupported_configs():
             base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 64")
             + "JOIN_MODE: warm\nEXCHANGE: ring\nFUSED_RECEIVE: 1\n"),
             collect_events=False)
+
+
+@pytest.mark.parametrize("drop,n,s,probes", [
+    (False, 512, 16, 2),
+    (True, 512, 16, 2),
+    # N=256, 8 shards -> L=32, S=64: (L*STRIDE) % S != 0, so the
+    # carry-select column-alignment branch (base2/r2) executes.
+    (False, 256, 64, 8),
+])
+def test_sharded_folded_run_bit_exact(drop, n, s, probes):
+    """Folded local planes on the sharded ring (8-shard virtual mesh):
+    identical trajectory to the natural sharded layout — the ppermute
+    block routing, bp/base column alignment (both the single-roll and
+    the wrapped-row carry-select cases), and P-folded probe pipeline all
+    cross shard boundaries folded."""
+    from distributed_membership_tpu.backends import get_backend
+
+    def run(folded):
+        dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\n"
+              "DROP_STOP: 90\n" if drop
+              else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+        p = Params.from_text(
+            f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{dk}"
+            f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 4}\nPROBES: {probes}\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 90\n"
+            "FAIL_TIME: 40\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            f"EXCHANGE: ring\nFOLDED: {folded}\n"
+            "BACKEND: tpu_hash_sharded\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend("tpu_hash_sharded")(p, seed=0)
+
+    r0, r1 = run(0), run(1)
+    f0 = r0.extra["final_state"]
+    f1 = r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "probe_ids1"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f0, name)).reshape(-1),
+            np.asarray(getattr(f1, name)).reshape(-1), err_msg=name)
+    for name in ("self_hb", "pending_recv", "failed"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])

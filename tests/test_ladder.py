@@ -1,0 +1,74 @@
+"""Evidence-ladder gating logic (scripts/tpu_ladder.py).
+
+The ladder is the round's TPU evidence pipeline; a gating regression
+silently costs a whole relay window.  These tests pin: rung bookkeeping
+against the artifact file, the Pallas-correctness gate (a recorded
+failure must exclude Pallas timing rungs but not folded/off rungs), and
+the correctness-failure record path.
+"""
+
+import importlib.util
+import json
+import os
+
+
+def _load_ladder(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "ladder", os.path.join(os.path.dirname(__file__), os.pardir,
+                               "scripts", "tpu_ladder.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.OUT = str(tmp_path / "TPU_PROFILE.json")
+    return mod
+
+
+def test_missing_starts_full(tmp_path):
+    lad = _load_ladder(tmp_path)
+    missing = lad._missing()
+    assert [r[0] for r in missing] == [r[0] for r in lad.LADDER]
+
+
+def test_done_rungs_drop_out(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": "65k_s64", "platform": "tpu",
+                "node_ticks_per_sec": 1.0})
+    names = [r[0] for r in lad._missing()]
+    assert "65k_s64" not in names
+    # Non-TPU rows don't count as done.
+    lad.append({"rung": "65k_s128", "platform": "cpu",
+                "node_ticks_per_sec": 1.0})
+    assert "65k_s128" in [r[0] for r in lad._missing()]
+
+
+def test_correctness_failure_gates_pallas_rungs_only(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": False,
+                "mismatched_elements": {"fused_gossip": {".view": 3}}})
+    modes = {r[0]: r[4] for r in lad._missing()}
+    assert not any(m in ("recv", "gossip", "both") for m in modes.values())
+    # Folded and natural rungs are layout work, not Pallas — still run.
+    assert any(m == "folded" for m in modes.values())
+    assert any(m == "off" for m in modes.values())
+
+
+def test_correctness_pass_keeps_pallas_rungs(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {}})
+    modes = [r[4] for r in lad._missing()]
+    assert any(m in ("recv", "gossip", "both") for m in modes)
+
+
+def test_append_is_crash_safe_json(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": "a", "platform": "tpu", "node_ticks_per_sec": 1.0})
+    lad.append({"rung": "b", "platform": "tpu", "node_ticks_per_sec": 2.0})
+    with open(lad.OUT) as fh:
+        recs = json.load(fh)
+    assert [r["rung"] for r in recs] == ["a", "b"]
+    # A corrupt file must not brick the daemon.
+    with open(lad.OUT, "w") as fh:
+        fh.write("{broken")
+    assert lad._load() == []
