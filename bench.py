@@ -122,6 +122,8 @@ def leg_hash(n: int, ticks: int, pin: str | None) -> dict:
     return {
         "leg": "hash", "platform": platform, "n": n, "ticks": ticks,
         "fused": fused, "folded": folded == "on",
+        "mode": ("folded" if folded == "on" else
+                 f"fused:{fused}" if fused != "off" else "natural"),
         "node_ticks_per_sec": round(n * ticks / wall, 1),
         "wall_seconds": round(wall, 3),
         "ticks_per_sec": round(ticks / wall, 2),
@@ -246,7 +248,7 @@ def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
         return None
     if r.returncode != 0:
         tail = (r.stderr or r.stdout or "").strip().splitlines()[-8:]
-        if any("ValueError" in line for line in tail):
+        if any(line.startswith("ValueError") for line in tail):
             # A config rejection (e.g. BENCH_FOLDED with an unsupported
             # view size) is deterministic — retrying rungs or headlining
             # banked evidence from a DIFFERENT config would silently
@@ -359,12 +361,9 @@ def main() -> int:
     value = hash_res["node_ticks_per_sec"]
     source = hash_res.get("banked_from", "live")
     timing = hash_res.get("timing", "warm_cache")
-    # Mode provenance: banked rows carry a normalized "mode"; live leg
-    # records carry the BENCH_FUSED string and the folded bool.
-    mode = hash_res.get("mode") or (
-        "folded" if hash_res.get("folded") else
-        f"fused:{hash_res['fused']}"
-        if hash_res.get("fused") not in (None, "off") else "natural")
+    # Mode provenance: both banked rows (_best_banked_tpu) and live leg
+    # records (leg_hash) carry a normalized "mode".
+    mode = hash_res.get("mode", "natural")
     out = {
         "metric": (f"node_ticks_per_sec (tpu_hash N={hash_res['n']}, "
                    f"S={hash_res['view_size']}, P={hash_res['probes']}, "
@@ -385,7 +384,7 @@ def main() -> int:
     }
     if live_cpu is not None:
         out["live_cpu"] = {k: live_cpu[k] for k in
-                           ("n", "ticks", "view_size", "exchange",
+                           ("n", "ticks", "view_size", "exchange", "mode",
                             "node_ticks_per_sec", "ticks_per_sec",
                             "wall_seconds") if k in live_cpu}
     if dense_res is not None and (dense_res["node_ticks_per_sec"]

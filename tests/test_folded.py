@@ -43,25 +43,35 @@ def test_roll_decompositions(n, s):
                                       err_msg=f"roll_slots c={c}")
 
 
-def _run(folded: int, drop: bool):
+def _run(folded: int, drop: bool, n: int = 512, s: int = 16,
+         probes: int = 2, seed: int = 0):
     dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\nDROP_STOP: 90\n"
           if drop else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
     p = Params.from_text(
-        f"MAX_NNB: 512\nSINGLE_FAILURE: 1\n{dk}"
-        "VIEW_SIZE: 16\nGOSSIP_LEN: 4\nPROBES: 2\nFANOUT: 3\nTFAIL: 16\n"
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{dk}"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {max(s // 4, 1)}\n"
+        f"PROBES: {probes}\nFANOUT: 3\nTFAIL: 16\n"
         "TREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\nJOIN_MODE: warm\n"
         f"EVENT_MODE: agg\nEXCHANGE: ring\nFOLDED: {folded}\n"
         "BACKEND: tpu_hash\n")
-    plan = make_plan(p, random.Random("app:0"))
-    return run_scan(p, plan, seed=0, collect_events=False)
+    plan = make_plan(p, random.Random(f"app:{seed}"))
+    return run_scan(p, plan, seed=seed, collect_events=False)
 
 
-@pytest.mark.parametrize("drop", [False, True])
-def test_folded_run_bit_exact(drop):
+@pytest.mark.parametrize("drop,n,s,probes,seed", [
+    (False, 512, 16, 2, 0),
+    (True, 512, 16, 2, 0),
+    # Other fold factors: F=16 (S=8), F=4 (S=32), F=2 (S=64); a second
+    # seed for trajectory diversity.
+    (False, 512, 8, 1, 1),
+    (False, 768, 32, 4, 0),
+    (True, 256, 64, 8, 1),
+])
+def test_folded_run_bit_exact(drop, n, s, probes, seed):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")   # small TREMOVE under loss is fine
-        f0, e0 = _run(0, drop)
-        f1, e1 = _run(1, drop)
+        f0, e0 = _run(0, drop, n, s, probes, seed)
+        f1, e1 = _run(1, drop, n, s, probes, seed)
     for name in ("view", "view_ts", "mail", "probe_ids1", "probe_ids2"):
         np.testing.assert_array_equal(
             np.asarray(getattr(f0, name)).reshape(-1),
