@@ -3,10 +3,12 @@
 The fused kernel (ops/fused_receive.py) is pinned bit-exactly against
 `receive_core` in interpret mode on CPU (tests/test_fused_receive.py); this
 script closes the remaining gap — the actual Mosaic TPU lowering — by
-running the full `tpu_hash` scan twice on the real chip (FUSED_RECEIVE
-off/on, same seed) and comparing final states and detection summaries
-bit-for-bit.  Exit 0 = identical.  The comparison is same-platform only:
-fused-vs-jnp on whatever backend resolve_platform selects.
+running the full `tpu_hash` scan under each mode on the real chip (same
+seed) and comparing final states bit-for-bit: the receive kernel under
+drops, the gossip kernel and the two-kernel composition drop-free, and
+the folded S=16 layout vs the natural one (droppy).  Exit 0 = all
+identical.  The comparison is same-platform only: each variant vs the
+baseline on whatever backend resolve_platform selects.
 
 Run it whenever the relay is up:  python scripts/tpu_correctness.py
 """
@@ -22,7 +24,8 @@ sys.path.insert(0, REPO)
 
 
 def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
-             n: int = 8192, s: int = 128, ticks: int = 60):
+             n: int = 8192, s: int = 128, ticks: int = 60,
+             folded: bool = False):
     import random as _pyrandom
 
     import numpy as np
@@ -41,7 +44,7 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
-        f"FUSED_GOSSIP: {int(fused_gossip)}\n"
+        f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
         f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
     final_state, _ = run_scan(params, plan, seed=0, collect_events=False)
@@ -86,6 +89,18 @@ def main() -> int:
     both = run_once(True, True, False, n=args.n, ticks=args.ticks)
     checks["fused_gossip"] = diff(base, goss)
     checks["fused_both"] = diff(base, both)
+    # Folded layout vs the natural layout (S=16 so there is padding to
+    # remove; the folded planes reshape to the natural ones for the
+    # comparison).  This is the on-chip gate for the *_folded ladder
+    # rungs: bit-exactness is pinned on CPU, this re-checks the real
+    # XLA:TPU lowering (dynamic lane rolls, cross-fold gathers).
+    base_s16 = run_once(False, False, True, n=args.n, s=16,
+                        ticks=args.ticks)
+    fold_s16 = run_once(False, False, True, n=args.n, s=16,
+                        ticks=args.ticks, folded=True)
+    checks["folded_s16"] = {
+        k: int((base_s16[k].reshape(-1) != fold_s16[k].reshape(-1)).sum())
+        for k in base_s16}
 
     mism = {name: {k: v for k, v in d.items() if v}
             for name, d in checks.items()}

@@ -38,11 +38,11 @@ OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 # (name, n, view, ticks, mode, timeout_s) — smallest first; timeouts
 # sized ~4x the expected wall so a hung relay is cut quickly.  mode:
 # 'off' | 'recv' (Pallas receive kernel) | 'gossip' (Pallas gossip
-# delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128 —
-# no Pallas, so not gated by the correctness rung).  The special first
-# rung runs scripts/tpu_correctness.py (fused-vs-jnp bit-equality for
-# both Pallas kernels on the real Mosaic lowering — 5 scans) instead of
-# a timing point; a failure there gates the Pallas timing rungs off.
+# delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128).
+# The special first rung runs scripts/tpu_correctness.py (bit-equality
+# of both Pallas kernels AND the folded layout vs the baseline on the
+# real chip — 7 scans) instead of a timing point; a failing family
+# gates only its own timing rungs (Pallas vs folded).
 CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
 # Cheap hardware probe of the S<128 lane-padding premise (PERF.md) —
 # memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
@@ -162,12 +162,17 @@ def _missing() -> list:
     # A recorded correctness FAILURE gates the fused timing rungs off: a
     # kernel that miscompiles on Mosaic must not contribute perf evidence.
     corr = done.get(CORRECTNESS_RUNG[0])
-    fused_ok = corr is None or corr.get("ok", False)
+    mism = (corr or {}).get("mismatched_elements", {})
+    fused_ok = corr is None or not any(
+        mism.get(k) for k in ("fused_receive", "fused_gossip",
+                              "fused_both"))
+    folded_ok = corr is None or not mism.get("folded_s16")
     pallas = ("recv", "gossip", "both")
     return [r for r in LADDER
             if r[0] not in done
             and not (r[4] in pallas and r[2] % 128 != 0)
-            and not (r[4] in pallas and not fused_ok)]
+            and not (r[4] in pallas and not fused_ok)
+            and not (r[4] == "folded" and not folded_ok)]
 
 
 def one_pass() -> tuple[int, int]:
@@ -199,10 +204,17 @@ def one_pass() -> tuple[int, int]:
         append(rec)
         landed += 1
         if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
-            # Gate Pallas timing rungs off THIS pass too, not just the
-            # next (_missing() only sees the failure on re-read).
-            pending = [r for r in pending
-                       if r[4] not in ("recv", "gossip", "both")]
+            # Gate the failing family's timing rungs off THIS pass too,
+            # not just the next (_missing() only sees the failure on
+            # re-read).
+            mism = rec.get("mismatched_elements", {})
+            bad = set()
+            if any(mism.get(k) for k in ("fused_receive", "fused_gossip",
+                                         "fused_both")):
+                bad |= {"recv", "gossip", "both"}
+            if mism.get("folded_s16"):
+                bad.add("folded")
+            pending = [r for r in pending if r[4] not in bad]
         if "node_ticks_per_sec" in rec:
             print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
                   f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)

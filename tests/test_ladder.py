@@ -72,3 +72,15 @@ def test_append_is_crash_safe_json(tmp_path):
     with open(lad.OUT, "w") as fh:
         fh.write("{broken")
     assert lad._load() == []
+
+
+def test_folded_correctness_failure_gates_folded_rungs_only(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": False,
+                "mismatched_elements": {"fused_receive": {},
+                                        "folded_s16": {".view": 7}}})
+    modes = [r[4] for r in lad._missing()]
+    assert "folded" not in modes
+    # Pallas families were clean -> their rungs still run.
+    assert any(m in ("recv", "gossip", "both") for m in modes)
