@@ -160,7 +160,7 @@ def leg_dense(n: int, ticks: int, pin: str | None) -> dict:
 # --------------------------------------------------------------------------
 # Orchestrator
 
-def _best_banked_tpu() -> dict | None:
+def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
     """Best previously-banked real-TPU hash-leg row, for headline fallback.
 
     When the relay is down at capture time, a live CPU number must not be
@@ -168,8 +168,9 @@ def _best_banked_tpu() -> dict | None:
     evidence from artifacts/TPU_PROFILE.json (warm-cache ladder rungs) or
     artifacts/SCALE_SMOKE.json (compile-included scale rows), tagged with
     its provenance so the reader knows it is banked, not live.
+    ``art_dir`` overrides the artifacts directory (tests).
     """
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = art_dir or os.path.dirname(os.path.abspath(__file__))
     rows = []
     for fname, default_timing in (
             ("TPU_PROFILE.json", "warm_cache"),

@@ -99,6 +99,9 @@ def main() -> int:
                 f"MAX_NNB: {args.n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
                 f"MSG_DROP_PROB: {args.drop}\nVIEW_SIZE: {args.view}\n"
                 f"PROBES: {args.probes}\nTREMOVE: {1 << 20}\n"
+                # Same whole-run drop window as the actual run below —
+                # the floor is window-aware (min_tremove_cycles_under_loss).
+                f"DROP_START: 0\nDROP_STOP: {args.ticks}\n"
                 f"TOTAL_TIME: {args.ticks}\nJOIN_MODE: warm\n"
                 f"BACKEND: {args.backend}\n")
             k_cycles = max(5, probe.min_tremove_cycles_under_loss() + 1)

@@ -89,18 +89,28 @@ def main() -> int:
     both = run_once(True, True, False, n=args.n, ticks=args.ticks)
     checks["fused_gossip"] = diff(base, goss)
     checks["fused_both"] = diff(base, both)
-    # Folded layout vs the natural layout (S=16 so there is padding to
-    # remove; the folded planes reshape to the natural ones for the
-    # comparison).  This is the on-chip gate for the *_folded ladder
-    # rungs: bit-exactness is pinned on CPU, this re-checks the real
-    # XLA:TPU lowering (dynamic lane rolls, cross-fold gathers).
-    base_s16 = run_once(False, False, True, n=args.n, s=16,
-                        ticks=args.ticks)
-    fold_s16 = run_once(False, False, True, n=args.n, s=16,
-                        ticks=args.ticks, folded=True)
-    checks["folded_s16"] = {
-        k: int((base_s16[k].reshape(-1) != fold_s16[k].reshape(-1)).sum())
-        for k in base_s16}
+    # Folded layout vs the natural layout at each fold factor the ladder
+    # times (S=16 -> F=8, S=64 -> F=2; the folded planes reshape to the
+    # natural ones for the comparison).  These are the on-chip gates for
+    # the matching *_folded ladder rungs: bit-exactness is pinned on CPU,
+    # this re-checks the real XLA:TPU lowering (dynamic lane rolls,
+    # cross-fold gathers).  Skipped (with a note) when --n doesn't fold.
+    from distributed_membership_tpu.backends.tpu_hash_folded import (
+        folded_supported)
+
+    for s_f in (16, 64):
+        probes_f = s_f // 8
+        if not folded_supported(args.n, s_f, probes_f):
+            print(f"note: folded_s{s_f} skipped — n={args.n} does not "
+                  f"fold at S={s_f}", flush=True)
+            continue
+        base_f = run_once(False, False, True, n=args.n, s=s_f,
+                          ticks=args.ticks)
+        fold_f = run_once(False, False, True, n=args.n, s=s_f,
+                          ticks=args.ticks, folded=True)
+        checks[f"folded_s{s_f}"] = {
+            k: int((base_f[k].reshape(-1) != fold_f[k].reshape(-1)).sum())
+            for k in base_f}
 
     mism = {name: {k: v for k, v in d.items() if v}
             for name, d in checks.items()}

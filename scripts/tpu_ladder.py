@@ -43,7 +43,7 @@ OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 # of both Pallas kernels AND the folded layout vs the baseline on the
 # real chip — 7 scans) instead of a timing point; a failing family
 # gates only its own timing rungs (Pallas vs folded).
-CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
+CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 1800)
 # Cheap hardware probe of the S<128 lane-padding premise (PERF.md) —
 # memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
 # timing; decides whether the folded layout is the next step.
@@ -157,22 +157,39 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
     return rec
 
 
+PALLAS_MODES = ("recv", "gossip", "both")
+
+
+def _rung_gated(rung, corr) -> bool:
+    """Whether a recorded correctness verdict blocks this timing rung: a
+    variant that miscompiles on the real chip must not contribute perf
+    evidence.  Family-granular when the record carries per-family
+    mismatch detail; a detail-free failure gates every non-natural rung
+    (fail closed)."""
+    mode, view = rung[4], rung[2]
+    if mode == "off" or corr is None or corr.get("ok", False):
+        return False
+    mism = corr.get("mismatched_elements", {})
+    if not any(mism.values()):
+        return True          # ok=false with no detail: gate all variants
+    if mode in PALLAS_MODES:
+        return any(mism.get(k) for k in ("fused_receive", "fused_gossip",
+                                         "fused_both"))
+    # folded: gate on the matching fold factor's check; a view with no
+    # dedicated check falls back to any folded failure (conservative).
+    key = f"folded_s{view}"
+    if key in mism:
+        return bool(mism[key])
+    return any(bool(v) for k, v in mism.items() if k.startswith("folded"))
+
+
 def _missing() -> list:
     done = load_done()
-    # A recorded correctness FAILURE gates the fused timing rungs off: a
-    # kernel that miscompiles on Mosaic must not contribute perf evidence.
     corr = done.get(CORRECTNESS_RUNG[0])
-    mism = (corr or {}).get("mismatched_elements", {})
-    fused_ok = corr is None or not any(
-        mism.get(k) for k in ("fused_receive", "fused_gossip",
-                              "fused_both"))
-    folded_ok = corr is None or not mism.get("folded_s16")
-    pallas = ("recv", "gossip", "both")
     return [r for r in LADDER
             if r[0] not in done
-            and not (r[4] in pallas and r[2] % 128 != 0)
-            and not (r[4] in pallas and not fused_ok)
-            and not (r[4] == "folded" and not folded_ok)]
+            and not (r[4] in PALLAS_MODES and r[2] % 128 != 0)
+            and not _rung_gated(r, corr)]
 
 
 def one_pass() -> tuple[int, int]:
@@ -204,17 +221,10 @@ def one_pass() -> tuple[int, int]:
         append(rec)
         landed += 1
         if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
-            # Gate the failing family's timing rungs off THIS pass too,
+            # Gate the failing families' timing rungs off THIS pass too,
             # not just the next (_missing() only sees the failure on
             # re-read).
-            mism = rec.get("mismatched_elements", {})
-            bad = set()
-            if any(mism.get(k) for k in ("fused_receive", "fused_gossip",
-                                         "fused_both")):
-                bad |= {"recv", "gossip", "both"}
-            if mism.get("folded_s16"):
-                bad.add("folded")
-            pending = [r for r in pending if r[4] not in bad]
+            pending = [r for r in pending if not _rung_gated(r, rec)]
         if "node_ticks_per_sec" in rec:
             print(f"  rung {name}: {rec['node_ticks_per_sec']:.0f} "
                   f"node-ticks/s ({rec['ms_per_tick']} ms/tick)", flush=True)

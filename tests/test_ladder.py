@@ -84,3 +84,26 @@ def test_folded_correctness_failure_gates_folded_rungs_only(tmp_path):
     assert "folded" not in modes
     # Pallas families were clean -> their rungs still run.
     assert any(m in ("recv", "gossip", "both") for m in modes)
+
+
+def test_detail_free_failure_gates_all_variants(tmp_path):
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": False,
+                "mismatched_elements": {}})
+    modes = [r[4] for r in lad._missing()]
+    assert all(m == "off" for m in modes), modes
+
+
+def test_folded_gate_is_fold_factor_granular(tmp_path):
+    lad = _load_ladder(tmp_path)
+    # Only the F=2 (S=64) fold factor miscompiled.
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": False,
+                "mismatched_elements": {"fused_receive": {},
+                                        "folded_s16": {},
+                                        "folded_s64": {".view": 5}}})
+    rungs = {r[0]: r for r in lad._missing()}
+    assert "1M_s16_folded" in rungs and "65k_s16_folded" in rungs
+    assert "1M_s64_folded" not in rungs
+    assert any(r[4] in ("recv", "gossip", "both") for r in rungs.values())
