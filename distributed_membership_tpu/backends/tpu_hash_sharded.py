@@ -404,6 +404,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
+        stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for j in range(k_max):
             m = keep & (j < k_eff)[:, None]
             if use_drop:
@@ -417,8 +418,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             b = u // n_local
             c = lax.rem(u, n_local)
             payload_r, cnt_r = block_send((payload, cnt), b)
-            payload_r = jnp.roll(payload_r, c, axis=0)
             cnt_r = jnp.roll(cnt_r, c, axis=0)
+            recv_add = recv_add + cnt_r
             # Column alignment: receiver slot = sender slot + delta*STRIDE,
             # delta = b'*L + c' with b' = b - D on block wrap (receiving
             # shards me < b, exact via bp) and c' = c - L on row wrap
@@ -427,16 +428,35 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # usual scale config) — saving one [L, S] pass per shift.
             bp = jnp.where(me < b, b - n_shards, b)
             base1 = lax.rem(lax.rem(bp * n_local + c, s) + s, s)
-            r1 = jnp.roll(payload_r, lax.rem(base1 * cstride, s), axis=1)
+            s1 = lax.rem(base1 * cstride, s)
+            base2 = lax.rem(
+                lax.rem(bp * n_local + c - n_local, s) + s, s)
+            s2 = lax.rem(base2 * cstride, s)
+            if cfg.fused_gossip:
+                # The Pallas accumulate (below) applies the local row
+                # roll + column alignment for ALL shifts in one mail
+                # traversal (ops/fused_gossip.gossip_fused_stacked); the
+                # ppermute wire hop above stays as is.
+                stacked.append((payload_r, c, s1, s2))
+                continue
+            payload_r = jnp.roll(payload_r, c, axis=0)
+            r1 = jnp.roll(payload_r, s1, axis=1)
             if (n_local * STRIDE) % s == 0:
                 result = r1
             else:
-                base2 = lax.rem(
-                    lax.rem(bp * n_local + c - n_local, s) + s, s)
-                r2 = jnp.roll(payload_r, lax.rem(base2 * cstride, s), axis=1)
+                r2 = jnp.roll(payload_r, s2, axis=1)
                 result = jnp.where((l_idx >= c)[:, None], r1, r2)
             mail = jnp.maximum(mail, result)
-            recv_add = recv_add + cnt_r
+        if cfg.fused_gossip and stacked:
+            from distributed_membership_tpu.ops.fused_gossip import (
+                gossip_fused_stacked)
+            mail = gossip_fused_stacked(
+                n_local, s, k_max, (n_local * STRIDE) % s == 0,
+                jax.default_backend() != "tpu", mail,
+                jnp.stack([p for p, _, _, _ in stacked]),
+                jnp.stack([c for _, c, _, _ in stacked]),
+                jnp.stack([s1 for _, _, s1, _ in stacked]),
+                jnp.stack([s2 for _, _, _, s2 in stacked]))
         sent_tick = sent_gossip + sent_req + sent_rep
 
         if cold_join:
@@ -975,6 +995,13 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                 f"FOLDED on tpu_hash_sharded needs the per-shard row "
                 f"count to fold (L={n_local}, S={cfg.s}, P={cfg.probes}: "
                 "L must be a multiple of 128/S and 128/P)")
+    if cfg.fused_gossip and n_local < 8:
+        # make_config validated against global N; the stacked kernel's
+        # row blocks cover the LOCAL rows and need the 8-sublane tiling
+        # minimum (same rule as fused_receive below).
+        raise ValueError(
+            f"FUSED_GOSSIP on tpu_hash_sharded needs at least 8 rows per "
+            f"shard (got L={n_local})")
     if cfg.fused_receive:
         # make_config validated against global N; the kernel runs over the
         # LOCAL rows here.

@@ -56,6 +56,95 @@ def gossip_fused_supported(n: int, s: int) -> bool:
     return s % 128 == 0 and n >= 8 and (n * STRIDE) % s == 0
 
 
+def _lo_block_idx(i, b: int, rows: int, shift):
+    """Block index holding the FIRST sender row for output block ``i``
+    under a row shift (sender rows start at ``(i*b - shift) mod rows``;
+    shift in [0, rows) so one +rows keeps the dividend non-negative).
+    Shared by both kernels' scalar-prefetch index maps — the wrap math
+    is the subtlest part and must not fork."""
+    return jax.lax.rem(i * b - shift + rows, rows) // b
+
+
+def _assemble_senders(plo, phi, off, b: int):
+    """Concatenate the two fetched adjacent blocks and slice the B
+    sender rows starting at the in-block offset (in-VMEM dynamic slice)."""
+    rows2b = jnp.concatenate([plo, phi], axis=0)
+    return jax.lax.dynamic_slice_in_dim(rows2b, off, b, axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
+                         interpret: bool, mail: jax.Array,
+                         payloads: jax.Array, c_shifts: jax.Array,
+                         s1s: jax.Array, s2s: jax.Array) -> jax.Array:
+    """Sharded-ring variant: accumulate K PRE-ROUTED payloads into mail.
+
+    The torus exchange (tpu_hash_sharded.make_ring_sharded_step) routes
+    each shift's payload across shards with a ``ppermute`` (wire traffic
+    the kernel cannot absorb), then pays ~3 local [L, S] passes per shift
+    for the intra-shard row roll + column alignment + max.  This kernel
+    replaces that local tail: the grid walks (mail block, shift) with the
+    mail block VMEM-resident, sender rows arrive via scalar-prefetch
+    block indexing from the stacked ``payloads [K, L, S]`` (already
+    sender-masked and ppermuted, so per-shift drop masks WOULD be
+    representable here — the shared config gate still keeps FUSED_GOSSIP
+    drop-free for uniformity with the single-chip kernel), and the
+    column alignment applies ``s1s[j]`` — or the
+    ``s2s[j]``/receiver-row select pair when ``single_col`` is False
+    (the (L*STRIDE) % S != 0 wrapped-row case).  ~(2K + 2) local passes
+    instead of ~3K.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = _pick_block(rows)
+    nb = rows // b
+
+    def _lo_block(i, j, c, s1v, s2v):
+        return _lo_block_idx(i, b, rows, c[j])
+
+    def kernel(c_ref, s1_ref, s2_ref, mail_ref, plo_ref, phi_ref,
+               out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        c = c_ref[j]
+        off = jax.lax.rem(jax.lax.rem(i * b - c + rows, rows), b)
+        senders = _assemble_senders(plo_ref[0], phi_ref[0], off, b)
+        r1 = pltpu.roll(senders, s1_ref[j], axis=1)
+        if single_col:
+            delivered = r1
+        else:
+            r2 = pltpu.roll(senders, s2_ref[j], axis=1)
+            recv_row = i * b + jax.lax.broadcasted_iota(I32, (b, s), 0)
+            delivered = jnp.where(recv_row >= c, r1, r2)
+
+        @pl.when(j == 0)
+        def _init():
+            out_ref[:] = mail_ref[:]
+
+        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nb, k_max),
+        in_specs=[
+            pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
+            pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
+                         (j, _lo_block(i, j, c, s1v, s2v), 0)),
+            pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
+                         (j, jax.lax.rem(
+                             _lo_block(i, j, c, s1v, s2v) + 1, nb), 0)),
+        ],
+        out_specs=pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, s), U32),
+        interpret=interpret,
+    )(c_shifts.astype(I32), s1s.astype(I32), s2s.astype(I32),
+      mail, payloads, payloads)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
                  mail: jax.Array, payload: jax.Array,
@@ -79,21 +168,15 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
     cstride = STRIDE % s
 
     def _lo_block(i, j, sh):
-        # Sender rows start at (i*b - sh[j]) mod rows; sh[j] in [1, rows)
-        # so one +rows keeps the dividend non-negative.
-        return jax.lax.rem(i * b - sh[j] + rows, rows) // b
+        return _lo_block_idx(i, b, rows, sh[j])
 
     def kernel(sh_ref, mail_ref, plo_ref, phi_ref, klo_ref, khi_ref,
                out_ref):
         i, j = pl.program_id(0), pl.program_id(1)
         r = sh_ref[j]
-        start = jax.lax.rem(i * b - r + rows, rows)
-        off = jax.lax.rem(start, b)
-
-        rows2b = jnp.concatenate([plo_ref[:], phi_ref[:]], axis=0)
-        senders = jax.lax.dynamic_slice_in_dim(rows2b, off, b, axis=0)
-        ke2b = jnp.concatenate([klo_ref[:], khi_ref[:]], axis=0)
-        ke = jax.lax.dynamic_slice_in_dim(ke2b, off, b, axis=0)
+        off = jax.lax.rem(jax.lax.rem(i * b - r + rows, rows), b)
+        senders = _assemble_senders(plo_ref[:], phi_ref[:], off, b)
+        ke = _assemble_senders(klo_ref[:], khi_ref[:], off, b)
         senders = jnp.where((j < ke)[:, None], senders, U32(0))
 
         # Column alignment: one shift for all rows (the supported case

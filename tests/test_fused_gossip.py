@@ -117,3 +117,76 @@ def test_fused_gossip_with_drops_rejected():
         "EXCHANGE: ring\nFUSED_GOSSIP: 1\nBACKEND: tpu_hash\n")
     with pytest.raises(ValueError, match="drop-free"):
         make_config(p)
+
+
+def test_stacked_kernel_matches_loop():
+    """gossip_fused_stacked (the sharded-ring local tail): pre-routed
+    stacked payloads, per-shift row shift + column alignment incl. the
+    two-roll receiver-row select."""
+    from distributed_membership_tpu.ops.fused_gossip import (
+        gossip_fused_stacked)
+
+    def ref(rows, mail, payloads, cs, s1s, s2s, single):
+        idx = jnp.arange(rows)
+        for j in range(payloads.shape[0]):
+            rolled = jnp.roll(payloads[j], cs[j], axis=0)
+            r1 = jnp.roll(rolled, s1s[j], axis=1)
+            d = r1 if single else jnp.where(
+                (idx >= cs[j])[:, None], r1,
+                jnp.roll(rolled, s2s[j], axis=1))
+            mail = jnp.maximum(mail, d)
+        return mail
+
+    for rows, s, k, single, seed in [(256, 128, 3, True, 0),
+                                     (64, 128, 4, False, 1),
+                                     (512, 256, 2, False, 2)]:
+        key = jax.random.PRNGKey(seed)
+        ks = jax.random.split(key, 5)
+        mail = jax.random.randint(ks[0], (rows, s), 0,
+                                  1 << 20).astype(jnp.uint32)
+        payloads = jnp.where(
+            jax.random.bernoulli(ks[1], 0.3, (k, rows, s)),
+            jax.random.randint(ks[2], (k, rows, s), 1,
+                               1 << 20).astype(jnp.uint32),
+            jnp.uint32(0))
+        cs = jax.random.randint(ks[3], (k,), 0, rows)
+        s1s = jax.random.randint(ks[4], (k,), 0, s)
+        s2s = (s1s + 7) % s
+        want = ref(rows, mail, payloads, cs, s1s, s2s, single)
+        got = gossip_fused_stacked(rows, s, k, single, True, mail,
+                                   payloads, cs, s1s, s2s)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got),
+                                      err_msg=f"{rows},{s},{k},{single}")
+
+
+@pytest.mark.parametrize("n", [1024, 256])
+def test_sharded_fused_gossip_end_to_end(n):
+    """FUSED_GOSSIP on tpu_hash_sharded ring == the jnp shift loop,
+    bit-exact on the 8-shard virtual mesh.  n=1024 -> L=128 (single
+    column roll); n=256 -> L=32 with (L*STRIDE) % S != 0, exercising the
+    in-kernel two-roll receiver-row select."""
+    import warnings
+
+    from distributed_membership_tpu.backends import get_backend
+    from distributed_membership_tpu.config import Params
+
+    def run(fg):
+        p = Params.from_text(
+            f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 128\nGOSSIP_LEN: 32\n"
+            "PROBES: 16\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+            "TOTAL_TIME: 100\nFAIL_TIME: 50\nJOIN_MODE: warm\n"
+            f"EVENT_MODE: agg\nEXCHANGE: ring\nFUSED_GOSSIP: {fg}\n"
+            "BACKEND: tpu_hash_sharded\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend("tpu_hash_sharded")(p, seed=0)
+
+    r0, r1 = run(0), run(1)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb", "pending_recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
