@@ -205,3 +205,18 @@ def test_ring_wrap_alignment_n_not_multiple_of_s():
     # Views stay stable after warm convergence (no churn from misdelivery).
     joins = np.asarray(ev.join_ids)
     assert (joins[80:plan.fail_time] == -1).all()
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_ring_cold_join_passes_grader(testcases_dir, scenario):
+    """Single-chip ring exchange through the grader's ACTUAL join
+    scenarios (EXCHANGE auto picks scatter here; this forces ring so the
+    circulant gossip + scatter-assisted join handshake is grader-tested,
+    mirroring tests/test_hash_sharded.py's sharded ring coverage)."""
+    params = Params.from_file(str(testcases_dir / f"{scenario}.conf"))
+    params.BACKEND = "tpu_hash"
+    params.EXCHANGE = "ring"
+    result = get_backend("tpu_hash")(params, seed=3)
+    g = grade_scenario(scenario, result.log.dbg_text(), 10)
+    assert g.passed, (g.details, g.points, g.max_points)
