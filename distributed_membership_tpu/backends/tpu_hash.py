@@ -786,22 +786,9 @@ def make_config(params: Params, collect_events: bool = True,
     fused = bool(params.FUSED_RECEIVE)
     if fused and exchange != "ring":
         raise ValueError("FUSED_RECEIVE requires the ring exchange")
-    if fused and not fused_supported(n, s):
-        raise ValueError(
-            f"FUSED_RECEIVE needs VIEW_SIZE % 128 == 0 and N >= 8 "
-            f"(got N={n}, S={s})")
     fused_g = bool(params.FUSED_GOSSIP)
     if fused_g and exchange != "ring":
         raise ValueError("FUSED_GOSSIP requires the ring exchange")
-    if fused_g and not gossip_fused_supported(n, s):
-        raise ValueError(
-            f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
-            f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s})")
-    if fused_g and params.effective_drop_prob() > 0:
-        raise ValueError(
-            "FUSED_GOSSIP requires a drop-free config (the jnp path "
-            "draws a fresh per-shift drop mask the kernel cannot "
-            "replicate bit-exactly)")
     folded = bool(params.FOLDED)
     if folded:
         from distributed_membership_tpu.backends.tpu_hash_folded import (
@@ -812,10 +799,6 @@ def make_config(params: Params, collect_events: bool = True,
         if collect_events:
             raise ValueError(
                 "FOLDED requires aggregate events (EVENT_MODE agg)")
-        if fused or fused_g:
-            raise ValueError(
-                "FOLDED and the FUSED_* Pallas kernels are mutually "
-                "exclusive (the kernels assume the natural layout)")
         if not folded_supported(n, s, params.PROBES):
             raise ValueError(
                 f"FOLDED needs 0 < VIEW_SIZE < 128 dividing 128, N a "
@@ -825,6 +808,31 @@ def make_config(params: Params, collect_events: bool = True,
             raise ValueError(
                 "FOLDED requires the FastAgg event path (a static failed "
                 f"set of at most {FAST_AGG_MAX_FAILED} ids)")
+        # Folded planes are [N*S/128, 128]: the minormost axis is already
+        # exactly 128 lanes, so the FUSED_* kernels apply on their folded
+        # twins (ops/fused_folded) — including, for gossip, under drops
+        # (the stacked-payload kernel takes pre-masked payloads).  The
+        # only extra requirement is the row-block tiling minimum.
+        if (fused or fused_g) and (n * s) // 128 < 8:
+            raise ValueError(
+                f"FOLDED FUSED_* kernels need at least 8 plane rows "
+                f"(N*VIEW_SIZE/128 >= 8; got N={n}, S={s})")
+    else:
+        if fused and not fused_supported(n, s):
+            raise ValueError(
+                f"FUSED_RECEIVE needs VIEW_SIZE % 128 == 0 and N >= 8 "
+                f"(got N={n}, S={s}); for S < 128 combine it with FOLDED")
+        if fused_g and not gossip_fused_supported(n, s):
+            raise ValueError(
+                f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
+                f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s}); for "
+                f"S < 128 combine it with FOLDED")
+        if fused_g and params.effective_drop_prob() > 0:
+            raise ValueError(
+                "FUSED_GOSSIP requires a drop-free config (the jnp path "
+                "draws a fresh per-shift drop mask the kernel cannot "
+                "replicate bit-exactly); the FOLDED stacked kernel "
+                "supports drops")
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,

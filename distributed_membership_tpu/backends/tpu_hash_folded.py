@@ -86,48 +86,36 @@ def roll_slots(x: jax.Array, c: jax.Array, s: int) -> jax.Array:
 
 
 def _folded_receive(n, tfail, tremove, rep, rowsum, self_mask, node,
-                    t, view, view_ts, mail, cand_sf, rcol, act, self_val):
+                    t, view, view_ts, mail, cand_sf, rcol, act, self_val,
+                    *, fused=False, s=0, stride=0, interpret=True, row0=0):
     """The receive pass (admit + ack-merge + self-write + TFAIL/TREMOVE
     sweep) on folded planes — the folded twin of
     ops/fused_receive._receive_body, shared by the single-chip and
-    sharded folded steps so the two cannot drift.
+    sharded folded steps so the two cannot drift.  The elementwise core
+    lives in ops/fused_folded._folded_receive_body; with ``fused`` it
+    runs as ONE Pallas traversal (receive_folded_fused — same body, so
+    the paths cannot drift either) and the per-node reductions happen
+    here on the returned planes.
 
     Returns (view, view_ts, mail_cleared, join_mask, rm_ids, numfailed,
     size, cur_id, present, difft)."""
-    in_id = ((mail - U32(1)) % U32(n)).astype(I32)
-    occupied = view > 0
-    matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-    ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
-    take = (mail > 0) & ok
-    admitted = jnp.where(take, jnp.maximum(view, mail), view)
-    new_view = jnp.where(rcol, admitted, view)
-    changed = new_view > view
-    new_ts = jnp.where(changed, t, view_ts)
-    join_mask = changed & ~occupied
-    mail = jnp.where(rcol, U32(0), mail)
+    from distributed_membership_tpu.ops.fused_folded import (
+        _folded_receive_body, receive_folded_fused)
 
-    c_id = ((cand_sf - U32(1)) % U32(n)).astype(I32)
-    v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
-    match = (cand_sf > 0) & (new_view > 0) & (c_id == v_id) & rcol
-    upd = match & (cand_sf > new_view)
-    new_view = jnp.where(upd, cand_sf, new_view)
-    new_ts = jnp.where(upd, t, new_ts)
-
-    s_on = self_mask & rep(act)
-    new_view = jnp.where(s_on, rep(self_val), new_view)
-    new_ts = jnp.where(s_on, t, new_ts)
-
-    present = new_view > 0
-    difft = t - new_ts
-    stale = present & (difft >= tfail) & rep(act)
+    if fused:
+        (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
+            receive_folded_fused(n, s, tfail, tremove, stride, interpret,
+                                 t, row0, view, view_ts, mail, cand_sf,
+                                 rcol, rep(act), rep(self_val))
+    else:
+        (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
+            _folded_receive_body(n, tfail, tremove, self_mask, node,
+                                 t, view, view_ts, mail, cand_sf,
+                                 rcol, rep(act), rep(self_val))
     numfailed = rowsum(stale.astype(I32))
-    removes = stale & (difft >= tremove)
+    present = new_view > 0
     cur_id = jnp.where(present,
                        ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
-    rm_ids = jnp.where(removes, cur_id, EMPTY)
-    new_view = jnp.where(removes, U32(0), new_view)
-    present = new_view > 0
-    cur_id = jnp.where(present, cur_id, EMPTY)
     size = rowsum(present.astype(I32))
     difft = t - new_ts
     return (new_view, new_ts, mail, join_mask, rm_ids, numfailed, size,
@@ -290,7 +278,8 @@ def make_folded_step(cfg):
          present, difft) = _folded_receive(
             n, cfg.tfail, cfg.tremove, rep, rowsum, self_mask, node,
             t, state.view, state.view_ts, state.mail, cand_sf, rcol, act,
-            self_val)
+            self_val, fused=cfg.fused_receive, s=s, stride=STRIDE,
+            interpret=jax.default_backend() != "tpu")
 
         # ---- gossip: circulant shifts in folded space ----
         numpotential = size - 1 - numfailed
@@ -303,6 +292,7 @@ def make_folded_step(cfg):
         shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n,), I32)
         recv_add = jnp.zeros((n,), I32)
+        stacked = []      # (payload, r, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
@@ -311,20 +301,37 @@ def make_folded_step(cfg):
                     (nf, LANES)) & drop_active)
             r = shifts[jshift]
             payload = jnp.where(m, view, U32(0))
-            rolled = roll_nodes(payload, r, f, s)
+            cnt = rowsum(m.astype(I32))
+            sent_gossip = sent_gossip + cnt
+            recv_add = recv_add + jnp.roll(cnt, r)
             s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+            s2 = (jnp.asarray(0, I32) if single_col_roll else jax.lax.rem(
+                jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s))
+            if cfg.fused_gossip:
+                # All shifts accumulate in ONE Pallas traversal below
+                # (ops/fused_folded.gossip_folded_stacked); payloads are
+                # fully masked here — including any drop masks — so the
+                # kernel is pure data movement.
+                stacked.append((payload, r, s1, s2))
+                continue
+            rolled = roll_nodes(payload, r, f, s)
             r1 = roll_slots(rolled, s1, s)
             if single_col_roll:
                 delivered = r1
             else:
-                s2 = jax.lax.rem(
-                    jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s)
                 r2 = roll_slots(rolled, s2, s)
                 delivered = jnp.where(rep((idx >= r)), r1, r2)
             mail = jnp.maximum(mail, delivered)
-            cnt = rowsum(m.astype(I32))
-            sent_gossip = sent_gossip + cnt
-            recv_add = recv_add + jnp.roll(cnt, r)
+        if cfg.fused_gossip and stacked:
+            from distributed_membership_tpu.ops.fused_folded import (
+                gossip_folded_stacked)
+            mail = gossip_folded_stacked(
+                nf, s, k_max, single_col_roll,
+                jax.default_backend() != "tpu", mail,
+                jnp.stack([p for p, _, _, _ in stacked]),
+                jnp.stack([r for _, r, _, _ in stacked]),
+                jnp.stack([s1 for _, _, s1, _ in stacked]),
+                jnp.stack([s2 for _, _, _, s2 in stacked]))
         sent_tick = sent_gossip
 
         # ---- SWIM probes (P-folded, shared window issue) ----
@@ -494,7 +501,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
          present, difft) = _folded_receive(
             n, cfg.tfail, cfg.tremove, rep, rowsum, self_mask, node,
             t, state.view, state.view_ts, state.mail, cand_sf, rcol, act,
-            self_val)
+            self_val, fused=cfg.fused_receive, s=s, stride=STRIDE,
+            interpret=jax.default_backend() != "tpu", row0=row0)
 
         # ---- gossip: torus-product shifts, folded local planes ----
         numpotential = size - 1 - numfailed
@@ -507,6 +515,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
         shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
+        stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
@@ -520,20 +529,39 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
             b = u // n_local
             c = lax.rem(u, n_local)
             payload_r, cnt_r = block_send((payload, cnt), b)
-            payload_r = roll_nodes(payload_r, c, f, s)
             cnt_r = jnp.roll(cnt_r, c, axis=0)
+            recv_add = recv_add + cnt_r
             bp = jnp.where(me < b, b - n_shards, b)
             base1 = lax.rem(lax.rem(bp * n_local + c, s) + s, s)
-            r1 = roll_slots(payload_r, lax.rem(base1 * cstride, s), s)
+            s1 = lax.rem(base1 * cstride, s)
+            base2 = lax.rem(
+                lax.rem(bp * n_local + c - n_local, s) + s, s)
+            s2 = lax.rem(base2 * cstride, s)
+            if cfg.fused_gossip:
+                # The Pallas accumulate below applies the intra-shard
+                # folded row roll + slot alignment for ALL shifts in one
+                # mail traversal (ops/fused_folded.gossip_folded_stacked);
+                # the ppermute wire hop above stays as is.
+                stacked.append((payload_r, c, s1, s2))
+                continue
+            payload_r = roll_nodes(payload_r, c, f, s)
+            r1 = roll_slots(payload_r, s1, s)
             if single_col_roll:
                 result = r1
             else:
-                base2 = lax.rem(
-                    lax.rem(bp * n_local + c - n_local, s) + s, s)
-                r2 = roll_slots(payload_r, lax.rem(base2 * cstride, s), s)
+                r2 = roll_slots(payload_r, s2, s)
                 result = jnp.where(rep(l_idx >= c), r1, r2)
             mail = jnp.maximum(mail, result)
-            recv_add = recv_add + cnt_r
+        if cfg.fused_gossip and stacked:
+            from distributed_membership_tpu.ops.fused_folded import (
+                gossip_folded_stacked)
+            mail = gossip_folded_stacked(
+                lf, s, k_max, single_col_roll,
+                jax.default_backend() != "tpu", mail,
+                jnp.stack([p for p, _, _, _ in stacked]),
+                jnp.stack([c for _, c, _, _ in stacked]),
+                jnp.stack([s1 for _, _, s1, _ in stacked]),
+                jnp.stack([s2 for _, _, _, s2 in stacked]))
         sent_tick = sent_gossip
 
         # ---- probe issue (P-folded, shared; prober attribution) ----

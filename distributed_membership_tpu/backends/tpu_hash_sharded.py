@@ -995,14 +995,23 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                 f"FOLDED on tpu_hash_sharded needs the per-shard row "
                 f"count to fold (L={n_local}, S={cfg.s}, P={cfg.probes}: "
                 "L must be a multiple of 128/S and 128/P)")
-    if cfg.fused_gossip and n_local < 8:
+    if cfg.folded and (cfg.fused_gossip or cfg.fused_receive):
+        # Folded twins of the fused kernels run over the LOCAL folded
+        # planes [L*S/128, 128]; only the row-block tiling minimum
+        # applies (make_config checked the global shape).
+        if (n_local * cfg.s) // 128 < 8:
+            raise ValueError(
+                f"FOLDED FUSED_* on tpu_hash_sharded needs at least 8 "
+                f"local plane rows (L*S/128 >= 8; got L={n_local}, "
+                f"S={cfg.s})")
+    elif cfg.fused_gossip and n_local < 8:
         # make_config validated against global N; the stacked kernel's
         # row blocks cover the LOCAL rows and need the 8-sublane tiling
         # minimum (same rule as fused_receive below).
         raise ValueError(
             f"FUSED_GOSSIP on tpu_hash_sharded needs at least 8 rows per "
             f"shard (got L={n_local})")
-    if cfg.fused_receive:
+    elif cfg.fused_receive:
         # make_config validated against global N; the kernel runs over the
         # LOCAL rows here.
         from distributed_membership_tpu.ops.fused_receive import (

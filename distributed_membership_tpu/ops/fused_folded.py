@@ -1,0 +1,295 @@
+"""Fused Pallas kernels for the FOLDED ``[N/F, 128]`` layout — the
+combination PERF.md's roofline says the 10k-ticks/s north star needs.
+
+Round 3 shipped two levers separately: the folded layout (S < 128 state
+stored at ``F = 128/S`` nodes per physical row — zero lane padding,
+backends/tpu_hash_folded.py) and the fused kernels (receive + gossip
+delivery as single Pallas traversals of the *natural* ``[N, S]`` layout,
+ops/fused_receive.py / ops/fused_gossip.py).  They were mutually
+exclusive by construction because the natural kernels assume
+``S % 128 == 0``.  This module lifts that: a folded plane's minormost
+axis is ALREADY exactly 128 lanes, so the same kernel patterns apply
+directly — per-node structure just moves into lane arithmetic
+(``node = row*F + lane//S``, ``slot = lane % S``), mirroring the jnp
+folded step.
+
+Two kernels:
+
+* :func:`receive_folded_fused` — the folded receive pass (admit +
+  ack-merge + self-write + TFAIL/TREMOVE sweep) in one traversal.  The
+  kernel body is :func:`_folded_receive_body`, which is ALSO the jnp
+  path's implementation (tpu_hash_folded._folded_receive calls it), so
+  the two cannot drift.  Per-node inputs arrive pre-broadcast as
+  ``[rows, 128]`` planes (``rep(act)``, ``rep(self_val)``, the rcol
+  mask): in-kernel re-broadcast of a per-node vector would need
+  lane-splitting reshapes Mosaic handles poorly, and the three extra
+  plane reads still leave this one traversal versus the jnp path's ~12.
+  Per-node reductions (numfailed/size) move OUT of the kernel: the
+  folded layout's row sums are segment sums over S-lane groups, so the
+  kernel returns the pre-remove ``stale`` mask as a plane and the caller
+  reduces — one extra fused XLA pass, no in-kernel lane-segment
+  reduction.
+
+* :func:`gossip_folded_stacked` — all ``fanout`` circulant shifts
+  delivered into the folded mailbox in one output-stationary traversal.
+  Stacked-payload design (like ops/fused_gossip.gossip_fused_stacked):
+  the caller masks each shift's payload in jnp and stacks them, so —
+  unlike the natural single-chip kernel — per-shift DROP masks are
+  representable bit-exactly and FOLDED+FUSED_GOSSIP supports lossy
+  configs.  In folded space a node-axis roll by ``r`` decomposes into an
+  aligned row roll ``rq = r//F`` plus a carry-select lane roll
+  ``rr = (r%F)*S`` (wrapped lanes take the once-more-rolled row), so the
+  kernel fetches ``B+1`` sender rows (the one extra row feeds the
+  carry), applies the lane roll + carry select, then the segment-wise
+  slot roll — tpu_hash_folded.roll_nodes/roll_slots exactly, block-local.
+
+Reference lineage: the step semantics being fused replicate
+/root/reference/MP1Node.cpp:404-495 (nodeLoopOps) and EmulNet delivery
+(/root/reference/EmulNet.cpp:87-118) — see the tpu_hash module docstring
+for the full mapping; the folded decompositions are proven against the
+natural layout in tests/test_folded.py and the fused twins against the
+jnp folded step in tests/test_fused_folded.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.ops.fused_receive import _pick_block
+
+I32 = jnp.int32
+U32 = jnp.uint32
+EMPTY = -1
+LANES = 128
+
+
+def _folded_receive_body(n: int, tfail: int, tremove: int,
+                         self_mask, node, t, view, view_ts, mail,
+                         cand_sf, rcol, actp, selfvalp):
+    """The folded receive pass, elementwise only — legal both as plain
+    jnp (tpu_hash_folded._folded_receive) and as a Pallas kernel body.
+
+    ``self_mask``/``node`` are the static element-coordinate planes
+    (closure constants in the jnp path, iota-derived in the kernel);
+    ``rcol``/``actp`` are the receive/act masks pre-broadcast to element
+    planes and ``selfvalp`` the packed self entry likewise (only its
+    self-slot elements matter).
+
+    Returns (view, view_ts, mail_cleared, join_mask, rm_ids, stale) —
+    ``stale`` is the pre-remove TFAIL mask; callers reduce it (and the
+    post-remove occupancy) to per-node numfailed/size.
+    """
+    in_id = ((mail - U32(1)) % U32(n)).astype(I32)
+    occupied = view > 0
+    matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
+    ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
+    take = (mail > 0) & ok
+    admitted = jnp.where(take, jnp.maximum(view, mail), view)
+    new_view = jnp.where(rcol, admitted, view)
+    changed = new_view > view
+    new_ts = jnp.where(changed, t, view_ts)
+    join_mask = changed & ~occupied
+    mail = jnp.where(rcol, U32(0), mail)
+
+    c_id = ((cand_sf - U32(1)) % U32(n)).astype(I32)
+    v_id = ((new_view - U32(1)) % U32(n)).astype(I32)
+    match = (cand_sf > 0) & (new_view > 0) & (c_id == v_id) & rcol
+    upd = match & (cand_sf > new_view)
+    new_view = jnp.where(upd, cand_sf, new_view)
+    new_ts = jnp.where(upd, t, new_ts)
+
+    s_on = self_mask & actp
+    new_view = jnp.where(s_on, selfvalp, new_view)
+    new_ts = jnp.where(s_on, t, new_ts)
+
+    present = new_view > 0
+    difft = t - new_ts
+    stale = present & (difft >= tfail) & actp
+    removes = stale & (difft >= tremove)
+    cur_id = jnp.where(present,
+                       ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
+    rm_ids = jnp.where(removes, cur_id, EMPTY)
+    new_view = jnp.where(removes, U32(0), new_view)
+    return new_view, new_ts, mail, join_mask, rm_ids, stale
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def receive_folded_fused(n: int, s: int, tfail: int, tremove: int,
+                         stride: int, interpret: bool,
+                         t, row0, view, view_ts, mail, cand_sf,
+                         rcol, actp, selfvalp):
+    """One-traversal Pallas version of the folded receive pass.
+
+    ``row0`` is the first plane row's global node-id offset (0
+    single-chip; ``shard * n_local`` on the sharded ring — traced, so it
+    rides SMEM next to ``t``).  Masks travel as int32 (bool VMEM tiling
+    is dtype-hostile, as in ops/fused_receive).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = view.shape[0]
+    f = LANES // s
+    b = _pick_block(rows)
+    grid = (rows // b,)
+
+    def kernel(sc_ref, view_ref, ts_ref, mail_ref, cand_ref, rcol_ref,
+               actp_ref, sval_ref,
+               view_out, ts_out, mailc_out, join_out, rm_out, stale_out):
+        i = pl.program_id(0)
+        t_k, row0_k = sc_ref[0], sc_ref[1]
+        lane = jax.lax.broadcasted_iota(I32, (b, LANES), 1)
+        prow = jax.lax.broadcasted_iota(I32, (b, LANES), 0)
+        pos = jax.lax.rem(lane, s)
+        node = row0_k + (i * b + prow) * f + lane // s
+        self_slot = jax.lax.rem(
+            jax.lax.rem(node, s) * ((1 + stride) % s), s)
+        self_mask = pos == self_slot
+        (nv, nts, mc, join, rm, stale) = _folded_receive_body(
+            n, tfail, tremove, self_mask, node, t_k,
+            view_ref[:], ts_ref[:], mail_ref[:], cand_ref[:],
+            rcol_ref[:] != 0, actp_ref[:] != 0, sval_ref[:])
+        view_out[:] = nv
+        ts_out[:] = nts
+        mailc_out[:] = mc
+        join_out[:] = join.astype(I32)
+        rm_out[:] = rm
+        stale_out[:] = stale.astype(I32)
+
+    row_spec = pl.BlockSpec((b, LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # (t, row0)
+            row_spec, row_spec, row_spec, row_spec,  # view, ts, mail, cand
+            row_spec, row_spec, row_spec,            # rcol, actp, selfvalp
+        ],
+        out_specs=[row_spec] * 6,
+        # Donate the state planes in place (view->view, ts->ts,
+        # mail->mail_cleared); input 0 is the SMEM scalar pair.
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, LANES), U32),   # view
+            jax.ShapeDtypeStruct((rows, LANES), I32),   # view_ts
+            jax.ShapeDtypeStruct((rows, LANES), U32),   # mail cleared
+            jax.ShapeDtypeStruct((rows, LANES), I32),   # join mask
+            jax.ShapeDtypeStruct((rows, LANES), I32),   # rm ids
+            jax.ShapeDtypeStruct((rows, LANES), I32),   # stale mask
+        ],
+        interpret=interpret,
+    )(jnp.stack([jnp.asarray(t, I32), jnp.asarray(row0, I32)]),
+      view, view_ts, mail, cand_sf, rcol.astype(I32), actp.astype(I32),
+      selfvalp)
+    (view2, ts2, mailc, join_i, rm_ids, stale_i) = out
+    return view2, ts2, mailc, join_i != 0, rm_ids, stale_i != 0
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
+                          interpret: bool, mail: jax.Array,
+                          payloads: jax.Array, thr: jax.Array,
+                          c1: jax.Array, c2: jax.Array) -> jax.Array:
+    """Accumulate K pre-masked folded payloads into the folded mailbox.
+
+    Per shift j the jnp folded path computes
+    ``roll_slots(roll_nodes(payloads[j], r_j), c1_j)`` (with a
+    ``node >= thr_j`` row select between the ``c1_j``/``c2_j`` slot
+    alignments when ``single_col`` is False) and maxes into mail — ~5
+    plane passes per shift.  Here the grid walks ``(mail block, shift)``
+    with the mail block VMEM-resident across all K shifts; sender rows
+    arrive by scalar-prefetch block indexing.
+
+    Args:
+      mail:     [rows, 128] u32 folded mailbox planes.
+      payloads: [K, rows, 128] u32 — per-shift sender-masked folded
+                views (entry thinning, fanout gating, and any DROP masks
+                already applied; on the sharded ring also already
+                ppermuted).
+      thr:      [K] i32 node-axis shift per stacked payload (the global
+                shift single-chip; the intra-shard residual on the
+                sharded ring) — the folded row-roll decomposition
+                ``rq = thr//F``, ``rr = (thr%F)*S``
+                (tpu_hash_folded.roll_nodes) is derived here, once, and
+                the same value is the node-index threshold of the
+                two-alignment receiver select when not ``single_col``.
+      c1, c2:   [K] i32 slot-roll amounts (tpu_hash_folded.roll_slots)
+                for unwrapped/wrapped receiver rows; ``c2`` ignored when
+                ``single_col``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    f = LANES // s
+    b = _pick_block(rows)
+    nb = rows // b
+    rq = thr.astype(I32) // f
+    rr = jax.lax.rem(thr.astype(I32), f) * s
+
+    def _lo_block(i, j, thr_v, rq_v, rr_v, c1_v, c2_v):
+        # First sender row for output block i is (i*b - rq - 1) mod rows
+        # (the -1 fetches the carry row roll_nodes' wrapped lanes need).
+        return jax.lax.rem(i * b - rq_v[j] - 1 + rows, rows) // b
+
+    def _seg_roll(x, c):
+        # tpu_hash_folded.roll_slots: segment-wise lane roll, c in [0, s).
+        lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
+        pos = jax.lax.rem(lane, s)
+        # roll by c-s == roll by c-s+128 over the 128-lane axis.
+        return jnp.where(pos < c, pltpu.roll(x, c + LANES - s, axis=1),
+                         pltpu.roll(x, c, axis=1))
+
+    def kernel(thr_ref, rq_ref, rr_ref, c1_ref, c2_ref,
+               mail_ref, plo_ref, phi_ref, out_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        rq_j, rr_j = rq_ref[j], rr_ref[j]
+        start = jax.lax.rem(i * b - rq_j - 1 + rows, rows)
+        off = jax.lax.rem(start, b)
+        rows2b = jnp.concatenate([plo_ref[0], phi_ref[0]], axis=0)
+        slab = jax.lax.dynamic_slice(rows2b, (off, 0), (b + 1, LANES))
+        # roll_nodes: a = rows rolled by rq, carry = rolled once more.
+        a = slab[1:]
+        carry = slab[:-1]
+        lane = jax.lax.broadcasted_iota(I32, (b, LANES), 1)
+        x = jnp.where(lane < rr_j, pltpu.roll(carry, rr_j, axis=1),
+                      pltpu.roll(a, rr_j, axis=1))
+        r1 = _seg_roll(x, c1_ref[j])
+        if single_col:
+            delivered = r1
+        else:
+            r2 = _seg_roll(x, c2_ref[j])
+            prow = jax.lax.broadcasted_iota(I32, (b, LANES), 0)
+            node = (i * b + prow) * f + lane // s
+            delivered = jnp.where(node >= thr_ref[j], r1, r2)
+
+        @pl.when(j == 0)
+        def _init():
+            out_ref[:] = mail_ref[:]
+
+        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nb, k_max),
+        in_specs=[
+            pl.BlockSpec((b, LANES),
+                         lambda i, j, *sc: (i, 0)),                 # mail
+            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                         (j, _lo_block(i, j, *sc), 0)),             # payload lo
+            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                         (j, jax.lax.rem(
+                             _lo_block(i, j, *sc) + 1, nb), 0)),    # payload hi
+        ],
+        out_specs=pl.BlockSpec((b, LANES), lambda i, j, *sc: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), U32),
+        interpret=interpret,
+    )(thr.astype(I32), rq, rr, c1.astype(I32),
+      c2.astype(I32), mail, payloads, payloads)

@@ -111,6 +111,15 @@ def main() -> int:
         checks[f"folded_s{s_f}"] = {
             k: int((base_f[k].reshape(-1) != fold_f[k].reshape(-1)).sum())
             for k in base_f}
+        # Folded+fused (ops/fused_folded): both Pallas twins on the
+        # folded planes vs the jnp folded step, droppy (the stacked
+        # gossip kernel supports drops — pre-masked payloads).  Gates
+        # the *_folded_fboth ladder rungs.
+        ffus_f = run_once(True, True, True, n=args.n, s=s_f,
+                          ticks=args.ticks, folded=True)
+        checks[f"folded_fused_s{s_f}"] = {
+            k: int((fold_f[k].reshape(-1) != ffus_f[k].reshape(-1)).sum())
+            for k in fold_f}
 
     mism = {name: {k: v for k, v in d.items() if v}
             for name, d in checks.items()}

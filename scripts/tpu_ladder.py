@@ -38,7 +38,9 @@ OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 # (name, n, view, ticks, mode, timeout_s) — smallest first; timeouts
 # sized ~4x the expected wall so a hung relay is cut quickly.  mode:
 # 'off' | 'recv' (Pallas receive kernel) | 'gossip' (Pallas gossip
-# delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128).
+# delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128)
+# | 'folded_fboth' (folded layout + BOTH folded-fused Pallas kernels,
+# ops/fused_folded — the north-star combination, PERF.md roofline).
 # The special first rung runs scripts/tpu_correctness.py (bit-equality
 # of both Pallas kernels AND the folded layout vs the baseline on the
 # real chip — 7 scans) instead of a timing point; a failing family
@@ -60,7 +62,9 @@ LADDER = [
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
     ("1M_s16_folded",    1 << 20,  16,  60, "folded", 600),
+    ("1M_s16_folded_fboth", 1 << 20, 16, 60, "folded_fboth", 600),
     ("65k_s16_folded",   1 << 16,  16, 150, "folded", 240),
+    ("65k_s16_folded_fboth", 1 << 16, 16, 150, "folded_fboth", 240),
     ("524k_s64",         1 << 19,  64,  60, "off",    600),
     ("1M_s64_folded",    1 << 20,  64,  60, "folded", 900),
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
@@ -115,10 +119,13 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
-               "--fused", "on" if fused in ("recv", "both") else "off",
+               "--fused",
+               "on" if fused in ("recv", "both", "folded_fboth") else "off",
                "--fused-gossip",
-               "on" if fused in ("gossip", "both") else "off",
-               "--folded", "on" if fused == "folded" else "off"]
+               "on" if fused in ("gossip", "both", "folded_fboth")
+               else "off",
+               "--folded",
+               "on" if fused in ("folded", "folded_fboth") else "off"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True, env=env, cwd=REPO)
@@ -167,7 +174,13 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
-    if mode == "off" or corr is None or corr.get("ok", False):
+    if mode == "off" or corr is None:
+        return False
+    if mode == "folded_fboth" and not _corr_covers_ladder(corr):
+        # The verdict predates the folded_fused families: fail closed
+        # until a covering correctness run lands (_missing re-arms it).
+        return True
+    if corr.get("ok", False):
         return False
     mism = corr.get("mismatched_elements", {})
     if not any(mism.values()):
@@ -175,17 +188,43 @@ def _rung_gated(rung, corr) -> bool:
     if mode in PALLAS_MODES:
         return any(mism.get(k) for k in ("fused_receive", "fused_gossip",
                                          "fused_both"))
+    if mode == "folded_fboth":
+        # Needs BOTH the folded layout and its fused twins clean at this
+        # fold factor; missing per-factor detail falls back to any
+        # folded/folded_fused failure (conservative).
+        keys = (f"folded_s{view}", f"folded_fused_s{view}")
+        if any(k in mism for k in keys):
+            return any(bool(mism.get(k)) for k in keys)
+        return any(bool(v) for k, v in mism.items()
+                   if k.startswith("folded"))
     # folded: gate on the matching fold factor's check; a view with no
     # dedicated check falls back to any folded failure (conservative).
     key = f"folded_s{view}"
     if key in mism:
         return bool(mism[key])
-    return any(bool(v) for k, v in mism.items() if k.startswith("folded"))
+    return any(bool(v) for k, v in mism.items()
+               if k.startswith("folded") and not k.startswith("folded_fused"))
+
+
+def _corr_covers_ladder(rec) -> bool:
+    """A banked correctness verdict is usable only if it covers every
+    kernel family this ladder gates on: records from before the
+    folded_fused checks existed (rounds <= 3) must re-run the
+    correctness rung, not silently green-light the *_folded_fboth
+    timing rungs (the script emits every family key — empty dict when
+    clean — so absence means the check never ran)."""
+    return rec is not None and any(
+        k.startswith("folded_fused")
+        for k in rec.get("mismatched_elements", {}))
 
 
 def _missing() -> list:
     done = load_done()
     corr = done.get(CORRECTNESS_RUNG[0])
+    if corr is not None and not _corr_covers_ladder(corr):
+        # Re-run the correctness rung (it's first in LADDER order); the
+        # stale verdict still gates the families it DID check meanwhile.
+        del done[CORRECTNESS_RUNG[0]]
     return [r for r in LADDER
             if r[0] not in done
             and not (r[4] in PALLAS_MODES and r[2] % 128 != 0)

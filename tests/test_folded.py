@@ -113,13 +113,13 @@ def test_folded_rejects_unsupported_configs():
         make_config(Params.from_text(base + "JOIN_MODE: warm\n"
                                      "EXCHANGE: ring\n"),
                     collect_events=True)
-    # FOLDED + FUSED_* can never co-validate: fused needs S % 128 == 0,
-    # folded needs S < 128 — whichever check fires first, it raises.
-    with pytest.raises(ValueError):
-        make_config(Params.from_text(
-            base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 64")
-            + "JOIN_MODE: warm\nEXCHANGE: ring\nFUSED_RECEIVE: 1\n"),
-            collect_events=False)
+    # FOLDED + FUSED_* co-validate since round 4 (ops/fused_folded lifts
+    # the round-3 exclusion); tests/test_fused_folded.py pins the
+    # combination's gates and bit-exactness.
+    cfg = make_config(Params.from_text(
+        base + "JOIN_MODE: warm\nEXCHANGE: ring\nFUSED_RECEIVE: 1\n"),
+        collect_events=False)
+    assert cfg.folded and cfg.fused_receive
 
 
 @pytest.mark.parametrize("drop,n,s,probes", [

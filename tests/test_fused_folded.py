@@ -1,0 +1,213 @@
+"""Folded+fused Pallas kernels == the jnp folded step, bit-exact.
+
+Round 3's two throughput levers — the [N/F, 128] folded layout and the
+fused Pallas kernels — were mutually exclusive; PERF.md's roofline says
+the 10k-ticks/s north star needs both at once.  ops/fused_folded lifts
+the exclusion; these tests pin the folded twins against the jnp folded
+step (which tests/test_folded.py pins against the natural layout, so
+exactness is transitive all the way to the reference-semantics path):
+
+* the unit level — gossip_folded_stacked vs the roll_nodes/roll_slots
+  loop across fold factors, boundary shifts, and both column-alignment
+  cases;
+* end-to-end single-chip — FOLDED+FUSED_* trajectories equal FOLDED
+  alone, with and without drops (the stacked gossip kernel takes
+  pre-masked payloads, so unlike the natural kernel it supports lossy
+  configs);
+* end-to-end sharded — the same on the 8-shard virtual mesh, covering
+  the (L*STRIDE) % S != 0 two-roll receiver select.
+
+Interpret mode throughout (no TPU in CI); the Mosaic lowering is gated
+on hardware by scripts/tpu_correctness.py like the natural kernels.
+"""
+
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.backends.tpu_hash import (
+    make_config, run_scan)
+from distributed_membership_tpu.backends.tpu_hash_folded import (
+    roll_nodes, roll_slots)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.ops.fused_folded import (
+    gossip_folded_stacked)
+from distributed_membership_tpu.runtime.failures import make_plan
+
+pytestmark = pytest.mark.quick
+
+
+def _stacked_reference(rows, s, f, mail, payloads, thr, c1, c2, single):
+    """The jnp folded gossip tail: roll_nodes + roll_slots (+ the
+    two-alignment receiver select) + max, per shift."""
+    n = rows * f
+    node = (jnp.arange(rows)[:, None] * f
+            + jnp.arange(128)[None, :] // s)
+    for j in range(payloads.shape[0]):
+        rolled = roll_nodes(payloads[j], thr[j], f, s)
+        r1 = roll_slots(rolled, c1[j], s)
+        if single:
+            d = r1
+        else:
+            r2 = roll_slots(rolled, c2[j], s)
+            d = jnp.where(node >= thr[j], r1, r2)
+        mail = jnp.maximum(mail, d)
+    return mail
+
+
+@pytest.mark.parametrize("n,s,k,single,seed", [
+    (1024, 16, 3, True, 0),
+    (256, 8, 4, False, 1),
+    (512, 32, 2, False, 2),
+    (128, 64, 3, True, 3),
+])
+def test_gossip_stacked_matches_folded_loop(n, s, k, single, seed):
+    f = 128 // s
+    rows = n // f
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 4)
+    mail = jax.random.randint(ks[0], (rows, 128), 0,
+                              1 << 20).astype(jnp.uint32)
+    payloads = jnp.where(
+        jax.random.bernoulli(ks[1], 0.3, (k, rows, 128)),
+        jax.random.randint(ks[2], (k, rows, 128), 1,
+                           1 << 20).astype(jnp.uint32),
+        jnp.uint32(0))
+    shifts = jax.random.randint(ks[3], (k,), 1, n)
+    c1 = (shifts % s) * 7 % s
+    c2 = (c1 + 5) % s
+    want = _stacked_reference(rows, s, f, mail, payloads, shifts, c1, c2,
+                              single)
+    got = gossip_folded_stacked(rows, s, k, single, True, mail, payloads,
+                                shifts, c1, c2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_gossip_stacked_boundary_shifts():
+    """Shifts 1, F-1, F, N-1 exercise the carry-lane select (rr != 0 and
+    rr == 0) at both block-wrap extremes."""
+    n, s = 512, 16
+    f = 128 // s
+    rows = n // f
+    key = jax.random.PRNGKey(7)
+    payload = jax.random.randint(key, (rows, 128), 0,
+                                 1 << 20).astype(jnp.uint32)
+    shifts = jnp.array([1, f - 1, f, n - 1], jnp.int32)
+    payloads = jnp.stack([payload] * 4)
+    mail = jnp.zeros((rows, 128), jnp.uint32)
+    c1 = (shifts % s) * 3 % s
+    c2 = jnp.zeros((4,), jnp.int32)
+    want = _stacked_reference(rows, s, f, mail, payloads, shifts, c1, c2,
+                              True)
+    got = gossip_folded_stacked(rows, s, 4, True, True, mail, payloads,
+                                shifts, c1, c2)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def _run(fr, fg, drop, n=512, s=16, probes=2, seed=0):
+    dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\nDROP_STOP: 90\n"
+          if drop else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    p = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{dk}"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {max(s // 4, 1)}\n"
+        f"PROBES: {probes}\nFANOUT: 3\nTFAIL: 16\n"
+        "TREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\nJOIN_MODE: warm\n"
+        "EVENT_MODE: agg\nEXCHANGE: ring\nFOLDED: 1\n"
+        f"FUSED_RECEIVE: {fr}\nFUSED_GOSSIP: {fg}\nBACKEND: tpu_hash\n")
+    plan = make_plan(p, random.Random(f"app:{seed}"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return run_scan(p, plan, seed=seed, collect_events=False)
+
+
+@pytest.mark.parametrize("fr,fg,drop", [
+    (1, 0, False), (0, 1, False), (1, 1, False),
+    (1, 1, True),   # drops: representable on the folded stacked kernel
+])
+def test_folded_fused_run_bit_exact(fr, fg, drop):
+    f0, e0 = _run(0, 0, drop)
+    f1, e1 = _run(fr, fg, drop)
+    for name in ("view", "view_ts", "mail", "probe_ids1", "probe_ids2",
+                 "self_hb", "pending_recv", "failed", "act_prev"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    for name in f0.agg._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(f0.agg, name)),
+                                      np.asarray(getattr(f1.agg, name)),
+                                      err_msg=f"agg.{name}")
+    for name in ("join_ids", "rm_ids", "sent", "recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(e0, name)),
+                                      np.asarray(getattr(e1, name)),
+                                      err_msg=name)
+
+
+@pytest.mark.parametrize("n,s,probes,drop", [
+    (512, 16, 2, False),    # L=64 -> lf=8: the row-block tiling boundary
+    (256, 64, 8, True),     # (L*STRIDE) % S != 0: two-roll select + drops
+])
+def test_sharded_folded_fused_bit_exact(n, s, probes, drop):
+    from distributed_membership_tpu.backends import get_backend
+
+    def run(fr, fg):
+        dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\n"
+              "DROP_STOP: 90\n" if drop
+              else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+        p = Params.from_text(
+            f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{dk}"
+            f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 4}\nPROBES: {probes}\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 90\n"
+            "FAIL_TIME: 40\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "EXCHANGE: ring\nFOLDED: 1\n"
+            f"FUSED_RECEIVE: {fr}\nFUSED_GOSSIP: {fg}\n"
+            "BACKEND: tpu_hash_sharded\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend("tpu_hash_sharded")(p, seed=0)
+
+    r0 = run(0, 0)
+    r1 = run(1, 1)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "probe_ids1", "self_hb",
+                 "pending_recv", "failed"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+
+
+def test_folded_fused_config_gates():
+    base = ("MAX_NNB: 512\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 4\nPROBES: 2\n"
+            "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\n"
+            "JOIN_MODE: warm\nEXCHANGE: ring\nEVENT_MODE: agg\n"
+            "BACKEND: tpu_hash\n")
+    # The combination is now accepted (round 3 forbade it)...
+    cfg = make_config(Params.from_text(
+        base + "FOLDED: 1\nFUSED_RECEIVE: 1\nFUSED_GOSSIP: 1\n"),
+        collect_events=False)
+    assert cfg.folded and cfg.fused_receive and cfg.fused_gossip
+    # ...including under drops (stacked payloads are pre-masked) ...
+    cfg = make_config(Params.from_text(
+        base.replace("DROP_MSG: 0", "DROP_MSG: 1")
+            .replace("MSG_DROP_PROB: 0", "MSG_DROP_PROB: 0.05")
+            .replace("TREMOVE: 64", "TREMOVE: 160")
+            .replace("TOTAL_TIME: 90", "TOTAL_TIME: 200")
+        + "FOLDED: 1\nFUSED_GOSSIP: 1\n"), collect_events=False)
+    assert cfg.folded and cfg.fused_gossip and cfg.drop_prob > 0
+    # ...but the natural-layout kernels still reject S < 128, pointing
+    # at FOLDED, and tiny planes still fail the row-block minimum.
+    with pytest.raises(ValueError, match="combine it with FOLDED"):
+        make_config(Params.from_text(base + "FUSED_RECEIVE: 1\n"),
+                    collect_events=False)
+    with pytest.raises(ValueError, match="at least 8 plane rows"):
+        make_config(Params.from_text(
+            base.replace("MAX_NNB: 512", "MAX_NNB: 48")
+                .replace("PROBES: 2", "PROBES: 0")
+            + "FOLDED: 1\nFUSED_RECEIVE: 1\n"), collect_events=False)

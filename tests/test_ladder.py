@@ -107,3 +107,41 @@ def test_folded_gate_is_fold_factor_granular(tmp_path):
     assert "1M_s16_folded" in rungs and "65k_s16_folded" in rungs
     assert "1M_s64_folded" not in rungs
     assert any(r[4] in ("recv", "gossip", "both") for r in rungs.values())
+
+
+def test_stale_correctness_verdict_rearms_and_fails_closed(tmp_path):
+    """A verdict from before the folded_fused families existed (round
+    <= 3 records) must re-arm the correctness rung AND gate the
+    *_folded_fboth timing rungs closed until a covering run lands —
+    while still gating/exonerating the families it did check."""
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {"fused_receive": {},
+                                        "folded_s16": {}}})
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    assert lad.CORRECTNESS_RUNG[0] in rungs          # re-armed
+    assert "1M_s16_folded_fboth" not in rungs        # fail closed
+    assert any(m in ("recv", "gossip", "both") for m in rungs.values())
+    assert "1M_s16_folded" in rungs                  # old families exonerated
+    # A covering clean verdict opens the folded_fboth rungs.
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {"fused_receive": {},
+                                        "folded_s16": {},
+                                        "folded_fused_s16": {}}})
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    assert lad.CORRECTNESS_RUNG[0] not in rungs
+    assert "1M_s16_folded_fboth" in rungs
+    # A covering verdict where only the folded_fused family failed
+    # gates folded_fboth but not the plain folded rungs.
+    lad2 = _load_ladder(tmp_path / "b")
+    (tmp_path / "b").mkdir()
+    lad2.append({"rung": lad2.CORRECTNESS_RUNG[0], "platform": "tpu",
+                 "check": "fused_vs_jnp_same_platform", "ok": False,
+                 "mismatched_elements": {"fused_receive": {},
+                                         "folded_s16": {},
+                                         "folded_fused_s16": {".view": 2}}})
+    rungs = {r[0]: r[4] for r in lad2._missing()}
+    assert "1M_s16_folded_fboth" not in rungs
+    assert "1M_s16_folded" in rungs
