@@ -30,6 +30,62 @@ import pytest  # noqa: E402
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
+# The `quick` smoke tier (`pytest -m quick`, pytest.ini): one seed and the
+# smallest shape per backend/component, curated here centrally so the tier
+# stays under a minute as files grow.  Coverage rule: every backend's
+# singlefailure grader pass, one unit test per custom op/kernel family,
+# and the pure-python components wholesale.  The full suite remains the
+# merge gate.
+_QUICK_ALL = {
+    "test_config.py", "test_eventlog.py", "test_grader.py",
+    "test_ladder.py", "test_bench_banked.py",
+}
+_QUICK = {
+    "test_grade_all.py": {"test_grade_all_native"},
+    "test_emul_backend.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_native_backend.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_tpu_backend.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_sharded.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_sparse_backend.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_hash_backend.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_hash_sharded.py": {"test_scenario_passes_grader[singlefailure]"},
+    "test_parity_gate.py": {"test_latency_window_and_mean[tpu_hash]"},
+    "test_ops.py": {"test_broadcast_deliver",
+                    "test_fanout_deliver_max_and_counts",
+                    "test_slot_of_no_int32_overflow"},
+    "test_collectives.py": {"test_reduce_scatter_sum_and_gather"},
+    "test_folded.py": {"test_roll_decompositions[256-16]",
+                       "test_folded_support_predicate",
+                       "test_folded_rejects_unsupported_configs"},
+    "test_fused_receive.py": {"test_fused_matches_core[256-128-40]"},
+    "test_fused_gossip.py": {"test_boundary_shifts",
+                             "test_stride_matches_backend"},
+    "test_fused_folded.py": {"test_gossip_stacked_boundary_shifts",
+                             "test_folded_fused_config_gates"},
+    "test_shell_oracle.py": {"test_magic_first_line"},
+    "test_package_results.py": {"test_package_results_archive"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    seen = {}
+    for item in items:
+        fname = pathlib.Path(item.fspath).name
+        seen.setdefault(fname, set()).add(item.name)
+        if fname in _QUICK_ALL or item.name in _QUICK.get(fname, ()):
+            item.add_marker(pytest.mark.quick)
+    # Tripwire: a renamed test (or changed parametrize id) must not
+    # silently drop out of the quick tier.  Checked only against files
+    # that actually collected, so single-file runs still work; a
+    # full-looking collection also checks the file names themselves.
+    stale = [f"{f}::{n}" for f, names in _QUICK.items() if f in seen
+             for n in names - seen[f]]
+    if len(seen) >= 10:
+        stale += [f for f in (_QUICK_ALL | set(_QUICK)) - set(seen)]
+    if stale:
+        raise pytest.UsageError(
+            f"conftest quick-tier list is stale (no such test): {stale}")
+
 
 @pytest.fixture(scope="session")
 def testcases_dir():

@@ -39,8 +39,6 @@ from distributed_membership_tpu.ops.fused_folded import (
     gossip_folded_stacked)
 from distributed_membership_tpu.runtime.failures import make_plan
 
-pytestmark = pytest.mark.quick
-
 
 def _stacked_reference(rows, s, f, mail, payloads, thr, c1, c2, single):
     """The jnp folded gossip tail: roll_nodes + roll_slots (+ the
