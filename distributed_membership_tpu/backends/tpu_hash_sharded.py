@@ -787,8 +787,28 @@ def make_sharded_step(cfg: HashConfig, n_local: int, n_shards: int):
         # a-plane carries (tgt, chan) packed; b-plane the entry payload.
         a_plane = (all_tgt.astype(U32) * U32(8) + all_chan.astype(U32))
         a_plane = jnp.where(all_ok, a_plane, U32(0xFFFFFFFF))
-        sort_key, a_sorted, b_sorted = jax.lax.sort(
-            (sort_key, a_plane, jnp.where(all_ok, all_val, 0)), num_keys=1)
+        b_plane = jnp.where(all_ok, all_val, 0)
+        n_msgs = all_tgt.size
+        if (n_shards * N_CH + 1) * (1 << 26) <= (1 << 32) \
+                and n_msgs <= (1 << 26):
+            # Pack (key, position) into ONE u32 and sort that alone: a
+            # single-operand sort is ~4.5x a 3-operand comparator sort
+            # (measured on the 8-dev CPU mesh: 65 vs 294 ms/shard at
+            # 795k messages — the dominant term of the scatter step's
+            # 10-min 32k warm-up, PERF.md), and the iota tie-break makes
+            # it bit-identical to the stable multi-operand order.  The
+            # payload planes follow by gather.  Falls back when the key
+            # range (> 64 shards x channels) or message count overflows
+            # the 6/26-bit packing.
+            iota = jax.lax.iota(U32, n_msgs)
+            packed = sort_key.astype(U32) * U32(1 << 26) + iota
+            packed = jax.lax.sort(packed)
+            perm = (packed & U32((1 << 26) - 1)).astype(I32)
+            a_sorted = a_plane[perm]
+            b_sorted = b_plane[perm]
+        else:
+            _, a_sorted, b_sorted = jax.lax.sort(
+                (sort_key, a_plane, b_plane), num_keys=1)
         counts = jnp.zeros((n_shards + 1,), I32).at[
             jnp.where(all_ok, dest, n_shards)].add(1, mode="drop")[:n_shards]
         offsets = jnp.concatenate(
