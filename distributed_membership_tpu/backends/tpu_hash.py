@@ -176,6 +176,10 @@ class HashConfig:
     #                              instead of fanout roll+max passes
     folded: bool = False         # [N/F, 128] folded physical layout for
     #                              S < 128 (backends/tpu_hash_folded.py)
+    send_budget: int = 0         # per-tick global send cap modeling
+    #                              EmulNet's bounded buffer (EN_BUFFSIZE
+    #                              drop-on-full, EmulNet.cpp:92-94);
+    #                              0 = unbounded (documented deviation)
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -346,6 +350,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         raise ValueError("ring mode needs PROBES < VIEW_SIZE "
                          f"(got {cfg.probes} >= {s})")
     if cfg.fused_gossip and (dynamic_knobs or cfg.drop_prob > 0
+                             or cfg.send_budget > 0
                              or not gossip_fused_supported(n, s)):
         # Drops draw a per-shift [N, S] mask the kernel cannot replicate
         # bit-exactly, and unsupported shapes need the two-roll wrapped-row
@@ -530,6 +535,17 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             cstride = STRIDE % s
             sent_gossip = jnp.zeros((n,), I32)
             recv_add = jnp.zeros((n,), I32)
+            # EmulNet bounded-buffer model (ENFORCE_BUFFSIZE): a per-tick
+            # global send budget consumed in the reference's traversal
+            # order — gossip shifts first, then probes, node-minor within
+            # each — with drop-on-full per message (EmulNet.cpp:92-94).
+            # Dropped sends never occupy the buffer.  Acks are exempt
+            # (README fidelity notes: the ring ack pipeline has no
+            # sender-side mailbox to budget).
+            track_budget = cfg.send_budget > 0
+            if track_budget:
+                budget = jnp.asarray(cfg.send_budget, I32)
+                used = jnp.zeros((), I32)
             if cfg.fused_gossip and not use_drop and k_max > 0:
                 # One Pallas traversal for all shifts (ops/fused_gossip):
                 # mail is read+written once; sender rows arrive by
@@ -553,6 +569,13 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                         m = m & ~(jax.random.bernoulli(
                             jax.random.fold_in(k_drop, j), p_drop, (n, s))
                             & drop_active)
+                    if track_budget:
+                        cnt0 = m.sum(1, dtype=I32)
+                        starts = used + jnp.cumsum(cnt0) - cnt0
+                        allowed = jnp.clip(budget - starts, 0, cnt0)
+                        m = m & (jnp.cumsum(m.astype(I32), axis=1)
+                                 <= allowed[:, None])
+                        used = used + allowed.sum(dtype=I32)
                     r = shifts[j]
                     payload = jnp.where(m, view, U32(0))
                     rolled = jnp.roll(payload, r, axis=0)
@@ -656,6 +679,18 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # pipeline both see only surviving probes.
                 p_valid = p_valid & ~(jax.random.bernoulli(
                     k_ack1, p_drop, p_valid.shape) & drop_active)
+            if track_budget:
+                # Probes queue after the gossip shifts; each costs p_red
+                # wire messages.  A budget-dropped probe is never
+                # recorded (like a coin-dropped one), so the ack pipeline
+                # and counters stay consistent.
+                pc = p_valid.sum(1, dtype=I32) * p_red
+                starts = used + jnp.cumsum(pc) - pc
+                accepted = jnp.clip(budget - starts, 0, pc) // p_red
+                p_valid = p_valid & (
+                    jnp.cumsum(p_valid.astype(I32), axis=1)
+                    <= accepted[:, None])
+                used = used + (accepted * p_red).sum(dtype=I32)
             ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
@@ -833,6 +868,33 @@ def make_config(params: Params, collect_events: bool = True,
                 "draws a fresh per-shift drop mask the kernel cannot "
                 "replicate bit-exactly); the FOLDED stacked kernel "
                 "supports drops")
+    send_budget = params.EN_BUFFSIZE if params.ENFORCE_BUFFSIZE else 0
+    if send_budget:
+        if exchange != "ring":
+            raise ValueError(
+                "ENFORCE_BUFFSIZE on tpu_hash requires the ring exchange "
+                "(the emul backends enforce the cap natively; the scatter "
+                "lowering does not model it — README fidelity notes)")
+        if params.BACKEND == "tpu_hash_sharded":
+            raise ValueError(
+                "ENFORCE_BUFFSIZE is not modeled on tpu_hash_sharded "
+                "(its scatter exchange bounds per-destination buckets "
+                "instead — bucket_capacity; README fidelity notes)")
+        if params.JOIN_MODE != "warm":
+            raise ValueError(
+                "ENFORCE_BUFFSIZE requires JOIN_MODE warm: cold-join "
+                "traffic (JOINREQ/JOINREP, introducer seed bursts) is "
+                "not budgeted, and join storms are exactly where the "
+                "reference's cap binds — use the emul backends for "
+                "capped cold joins")
+        if folded:
+            raise ValueError(
+                "ENFORCE_BUFFSIZE is not modeled on the FOLDED layout")
+        if fused_g:
+            raise ValueError(
+                "ENFORCE_BUFFSIZE and FUSED_GOSSIP are incompatible (the "
+                "budget is a per-slot send mask; the natural-layout kernel "
+                "applies its fanout mask in-kernel)")
     return HashConfig(
         n=n, s=s, g=min(g, s), tfail=params.TFAIL, tremove=params.TREMOVE,
         fanout=params.FANOUT,
@@ -842,7 +904,8 @@ def make_config(params: Params, collect_events: bool = True,
         fail_ids=tuple(fail_ids) if fast_agg else (),
         fast_agg=fast_agg,
         count_probe_io=n <= PROBE_IO_EXACT_MAX,
-        fused_receive=fused, fused_gossip=fused_g, folded=folded)
+        fused_receive=fused, fused_gossip=fused_g, folded=folded,
+        send_budget=send_budget)
 
 
 _RUNNER_CACHE: dict = {}

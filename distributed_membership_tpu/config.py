@@ -118,6 +118,15 @@ class Params:
     # JOIN_MODE warm, aggregate events, 128 % VIEW_SIZE == 0.  Bit-exact
     # with the natural layout (same seed -> same trajectory).
     FOLDED: int = 0
+    # Enforce EmulNet's bounded send buffer (EN_BUFFSIZE, reference
+    # ENBUFFSIZE=30000 with drop-on-full, EmulNet.cpp:92-94) on the
+    # tpu_hash ring exchange as a per-tick global send budget: sends are
+    # accepted in the reference's traversal order (gossip shifts, then
+    # probes; node-minor) until the budget is spent, the rest drop.  The
+    # emul backends always enforce the cap exactly; the jitted paths
+    # default to unbounded — see README "Network-semantics fidelity
+    # notes" for the deviation list.
+    ENFORCE_BUFFSIZE: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""

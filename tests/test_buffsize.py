@@ -1,0 +1,124 @@
+"""EmulNet bounded-send-buffer semantics (EN_BUFFSIZE, drop-on-full).
+
+The reference caps the in-flight network buffer at ENBUFFSIZE=30000 and
+drops sends when full (/root/reference/EmulNet.h:12, EmulNet.cpp:92-94).
+The emul backends enforce it natively; `ENFORCE_BUFFSIZE: 1` models it
+on the tpu_hash ring exchange as a per-tick global send budget (README
+"Network-semantics fidelity notes").  These tests pin: buffer pressure
+drops gossip on BOTH paths, the budget is a hard per-tick bound, a
+non-binding budget leaves the trajectory bit-identical, and the config
+gates for unsupported combinations.
+"""
+
+import random
+import warnings
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends.tpu_hash import (
+    make_config, run_scan)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.runtime.failures import make_plan
+
+pytestmark = pytest.mark.quick
+
+
+def _ring_run(enforce, buffsize, n=256, s=16):
+    p = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 2}\nPROBES: 2\nFANOUT: 3\n"
+        "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+        "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+        f"ENFORCE_BUFFSIZE: {enforce}\nEN_BUFFSIZE: {buffsize}\n"
+        "BACKEND: tpu_hash\n")
+    plan = make_plan(p, random.Random("app:0"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return run_scan(p, plan, seed=0, collect_events=False)
+
+
+def test_budget_bounds_ring_sends_per_tick():
+    budget = 400
+    _, ev_free = _ring_run(0, budget)
+    fs, ev = _ring_run(1, budget)
+    sent_free = np.asarray(ev_free.sent)
+    sent = np.asarray(ev.sent)
+    # Unbudgeted traffic is far above the budget (the pressure premise)...
+    assert sent_free.max() > 3 * budget
+    # ...the budget binds gossip+probes hard; acks are exempt and bounded
+    # by the in-flight probe count (N * PROBES of the previous tick).
+    n, probes = 256, 2
+    assert sent.max() <= budget + n * probes
+    # ...and drops messages overall (the emul-style pressure behavior).
+    assert sent.sum() < 0.5 * sent_free.sum()
+
+
+def test_nonbinding_budget_is_bit_exact():
+    f0, e0 = _ring_run(0, 10 ** 7)
+    f1, e1 = _ring_run(1, 10 ** 7)
+    for name in ("view", "view_ts", "mail", "probe_ids1", "probe_ids2",
+                 "self_hb", "pending_recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    np.testing.assert_array_equal(np.asarray(e0.sent), np.asarray(e1.sent))
+
+
+def test_emul_buffer_pressure_drops_gossip():
+    """The native oracle: shrinking EN_BUFFSIZE on the emul backend drops
+    sends the same way (drop-on-full at ENsend, EmulNet.cpp:92-94)."""
+    from distributed_membership_tpu.backends import get_backend
+
+    def run(buffsize):
+        p = Params.from_text(
+            "MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nTOTAL_TIME: 150\nBACKEND: emul\n"
+            f"EN_BUFFSIZE: {buffsize}\n")
+        return get_backend("emul")(p, seed=0)
+
+    free = run(30000)
+    tight = run(40)
+    assert tight.sent.sum() < 0.7 * free.sent.sum()
+
+
+def test_enforce_buffsize_config_gates():
+    base = ("MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+            "JOIN_MODE: warm\nEVENT_MODE: agg\nENFORCE_BUFFSIZE: 1\n"
+            "BACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="ring exchange"):
+        make_config(Params.from_text(base + "EXCHANGE: scatter\n"),
+                    collect_events=False)
+    with pytest.raises(ValueError, match="FOLDED"):
+        make_config(Params.from_text(base + "EXCHANGE: ring\nFOLDED: 1\n"),
+                    collect_events=False)
+    with pytest.raises(ValueError, match="FUSED_GOSSIP"):
+        make_config(Params.from_text(
+            base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 128")
+                .replace("PROBES: 2", "PROBES: 16")
+            + "EXCHANGE: ring\nFUSED_GOSSIP: 1\n"), collect_events=False)
+    # FUSED_RECEIVE composes (the budget masks sends, not the receive).
+    cfg = make_config(Params.from_text(
+        base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 128")
+            .replace("PROBES: 2", "PROBES: 16")
+        + "EXCHANGE: ring\nFUSED_RECEIVE: 1\n"), collect_events=False)
+    assert cfg.send_budget == 30000 and cfg.fused_receive
+
+
+def test_enforce_buffsize_backend_and_join_gates():
+    base = ("MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+            "EVENT_MODE: agg\nENFORCE_BUFFSIZE: 1\nEXCHANGE: ring\n")
+    # Silently-uncapped combinations must raise, not no-op: the sharded
+    # step has no budget plumbing, and cold-join storms are unbudgeted.
+    with pytest.raises(ValueError, match="tpu_hash_sharded"):
+        make_config(Params.from_text(
+            base + "JOIN_MODE: warm\nBACKEND: tpu_hash_sharded\n"),
+            collect_events=False)
+    with pytest.raises(ValueError, match="JOIN_MODE warm"):
+        make_config(Params.from_text(
+            base + "JOIN_MODE: batch\nBACKEND: tpu_hash\n"),
+            collect_events=False)
