@@ -96,14 +96,16 @@ from distributed_membership_tpu.ops.fused_gossip import (
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
-from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
+from distributed_membership_tpu.ops.view_merge import (
+    EMPTY, STRIDE, hash_slot)
 from distributed_membership_tpu.runtime.failures import (
     FailurePlan, make_plan, plan_tensors)
 
 I32 = jnp.int32
 U32 = jnp.uint32
-STRIDE = 7919  # odd prime: per-node slot-map offset decorrelates which id
-#                pairs collide across different nodes' views
+# STRIDE (re-exported above from ops/view_merge, its single source): odd
+# prime per-node slot-map offset — decorrelates which id pairs collide
+# across different nodes' views.
 # Above this node count the ring mode stops building the two full-width
 # [N*P]-index histograms that attribute probe recv / ack sends to their
 # true rows; totals stay exact, the per-node split becomes approximate

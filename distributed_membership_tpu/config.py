@@ -247,7 +247,13 @@ class Params:
         legs draw a coin — EmulNet.cpp:87-118 semantics); a false removal
         needs k = TREMOVE/cycle *consecutive* failed cycles for one entry,
         so by union bound the expected count is at most
-        ``N * VIEW_SIZE * (TOTAL_TIME/cycle) * q**k``.  The floor sizes k
+        ``N * VIEW_SIZE * (TOTAL_TIME/cycle) * q**k``.  The model counts
+        only probe/ack refreshes: gossip-driven refreshes (an entry also
+        refreshes when any neighbor gossips a higher heartbeat for it)
+        are deliberately ignored, so q overstates the per-cycle failure
+        probability and the floor is an UPPER bound on the needed
+        TREMOVE — a conservative warning that can fire for configs that
+        are actually safe, never the reverse.  The floor sizes k
         so that bound is <= 0.01, not merely < 1: the knee is sharp — at
         N=65536, S=16, p=0.1 a k targeting expectation < 1 still produced
         one false removal (artifacts/LOSS_STRESS.json maps the knee), so

@@ -45,10 +45,10 @@ import jax
 import jax.numpy as jnp
 
 from distributed_membership_tpu.ops.fused_receive import _pick_block
+from distributed_membership_tpu.ops.view_merge import STRIDE
 
 I32 = jnp.int32
 U32 = jnp.uint32
-STRIDE = 7919   # must match tpu_hash.STRIDE (asserted in tests)
 
 
 def gossip_fused_supported(n: int, s: int) -> bool:

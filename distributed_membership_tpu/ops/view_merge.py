@@ -32,6 +32,12 @@ import jax.numpy as jnp
 I32 = jnp.int32
 EMPTY = -1          # slot_id value for a free slot
 _ID_INF = 2**30     # sorts empty/invalid entries last
+# Per-node slot-map stride of the hash backends: odd prime, so which id
+# pairs collide decorrelates across nodes (h_i(id) = (id + i*STRIDE) % S
+# — backends/tpu_hash.py, which re-exports this).  Defined in this leaf
+# module so the Pallas kernels (ops/fused_gossip) share the SAME
+# constant instead of a test-pinned duplicate (ADVICE r3).
+STRIDE = 7919
 
 
 class MergeResult(NamedTuple):

@@ -45,7 +45,9 @@ def test_filters_and_warm_preference(tmp_path):
          "fanout": 3, "probes": 8, "exchange": "ring"},
     ])
     _write(tmp_path, "TPU_PROFILE.json", [
-        # Warm-cache rung: preferred over the (faster) cold row above.
+        # Slower warm-cache rung: throughput is the primary key, so the
+        # faster compile-included row above wins (a cold row UNDERSTATES
+        # its rate — ADVICE r3); warm provenance only breaks ties.
         {"platform": "tpu", "rung": "65k_s128", "n": 65536, "s": 128,
          "ticks": 100, "wall_seconds": 10.0, "ticks_per_sec": 10.0,
          "node_ticks_per_sec": 100000.0, "fanout": 3, "probes": 16,
@@ -55,9 +57,19 @@ def test_filters_and_warm_preference(tmp_path):
         {"platform": "tpu", "rung": "fused_correctness", "ok": True},
     ])
     row = bench._best_banked_tpu(str(tmp_path))
+    assert row["node_ticks_per_sec"] == 300000.0
+    assert row["timing"] == "cold_compile_included"
+    assert row["banked_from"] == "artifacts/SCALE_SMOKE.json"
+    # Equal throughput: warm-cache provenance breaks the tie.
+    _write(tmp_path, "TPU_PROFILE.json", [
+        {"platform": "tpu", "rung": "65k_s64", "n": 65536, "s": 64,
+         "ticks": 100, "wall_seconds": 10.0, "ticks_per_sec": 10.0,
+         "node_ticks_per_sec": 300000.0, "fanout": 3, "probes": 8,
+         "exchange": "ring", "timing": "warm_cache",
+         "implied_hbm_gbps": 5.0},
+    ])
+    row = bench._best_banked_tpu(str(tmp_path))
     assert row["timing"] == "warm_cache"
-    assert row["node_ticks_per_sec"] == 100000.0
-    assert row["banked_from"] == "artifacts/TPU_PROFILE.json"
     assert row["mode"] == "natural"
     assert row["est_hbm_gbps"] == 5.0
 
