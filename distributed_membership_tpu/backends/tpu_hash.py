@@ -119,14 +119,50 @@ PROBE_IO_EXACT_MAX = 1 << 17
 def probe_attribution_exact(params: Params) -> bool:
     """Whether per-node probe/ack recv counters are exactly attributed
     (see PROBE_IO_EXACT_MAX; scatter mode and probe-free configs always
-    are).  The sharded ring step uses prober attribution at EVERY size
-    (per-target attribution would need [N] psums per tick —
-    tpu_hash_sharded.make_ring_sharded_step docstring)."""
+    are).  ``PROBE_IO: exact|approx`` overrides the size gate — on the
+    sharded ring, exact attribution rides one bool all_gather plus two
+    [N]-histogram psum_scatters per tick (the per-target counts travel
+    the same wire the ack pipeline's [N] all_gather already does)."""
     if params.resolved_exchange() != "ring" or params.PROBES <= 0:
         return True
-    if params.BACKEND == "tpu_hash_sharded":
-        return False
+    if params.PROBE_IO != "auto":
+        return params.PROBE_IO == "exact"
     return params.EN_GPSZ <= PROBE_IO_EXACT_MAX
+
+
+def _will_flush(recv_mask, fail_mask, t, fail_time):
+    """Rows whose ``pending_recv`` accumulated THIS tick flushes at t+1:
+    receiving now AND not failing this tick — the failed flag is set at
+    the END of ``t == fail_time``, so pending added during that tick
+    strands forever (reference-faithfully: a crashed node never drains
+    its queue)."""
+    return recv_mask & ~(fail_mask & (t == fail_time))
+
+
+def _credit_orphan_recvs(per_prober, will_flush):
+    """Approx probe-recv attribution, single chip: keep rows that will
+    flush; recvs counted for a non-flushing prober (already dead — its
+    probes are still in flight — or failing this tick) would strand in
+    ITS pending where exact mode charges the live target instead, so
+    their sum is re-credited to one surviving row.  The per-node split
+    is approximate by contract; TOTALS match exact mode bit-for-bit
+    (tests/test_probe_io.py)."""
+    orphan = jnp.where(will_flush, 0, per_prober).sum(dtype=I32)
+    safe = jnp.argmax(will_flush).astype(I32)
+    return jnp.where(will_flush, per_prober, 0).at[safe].add(
+        jnp.where(will_flush.any(), orphan, 0))
+
+
+def _credit_orphan_recvs_sharded(per_prober, will_flush_l, will_flush_g,
+                                 lrows, axis):
+    """The sharded twin of :func:`_credit_orphan_recvs`: the orphan sum
+    rides a scalar psum and lands on the globally-first surviving row
+    (whichever shard owns it)."""
+    orphan = jax.lax.psum(
+        jnp.where(will_flush_l, 0, per_prober).sum(dtype=I32), axis)
+    safe_g = jnp.argmax(will_flush_g).astype(I32)
+    return jnp.where(will_flush_l, per_prober, 0) + jnp.where(
+        (lrows == safe_g) & will_flush_g.any(), orphan, 0)
 
 
 class HashState(NamedTuple):
@@ -715,11 +751,19 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             else:
                 # Scale mode: same global volume, attributed to the
                 # prober's row (per-node probe recv/ack-send counters
-                # would need full-width histograms — msgcount totals stay
-                # exact, per-node split is approximate for probe traffic).
-                in_flight = v1.sum(1, dtype=I32)
-                recv_probe = in_flight * p_red
-                sent_ack = in_flight
+                # would need full-width histograms — msgcount TOTALS stay
+                # exact, the per-node split is approximate for probe
+                # traffic; tests/test_probe_io.py pins the equality).
+                # Ack sends take the exact branch's act[tgt] filter (a
+                # dead target sends no ack); recv filtering and the
+                # orphan re-credit live in _will_flush /
+                # _credit_orphan_recvs.
+                will_flush = _will_flush(recv_mask, fail_mask, t,
+                                         fail_time)
+                per_prober = (v1 & will_flush[tgt1]).sum(1, dtype=I32) \
+                    * p_red
+                recv_probe = _credit_orphan_recvs(per_prober, will_flush)
+                sent_ack = (v1 & act[tgt1]).sum(1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
         elif cfg.probes > 0:
@@ -905,7 +949,9 @@ def make_config(params: Params, collect_events: bool = True,
         collect_events=collect_events, exchange=exchange,
         fail_ids=tuple(fail_ids) if fast_agg else (),
         fast_agg=fast_agg,
-        count_probe_io=n <= PROBE_IO_EXACT_MAX,
+        count_probe_io=(n <= PROBE_IO_EXACT_MAX
+                        if params.PROBE_IO == "auto"
+                        else params.PROBE_IO == "exact"),
         fused_receive=fused, fused_gossip=fused_g, folded=folded,
         send_budget=send_budget)
 

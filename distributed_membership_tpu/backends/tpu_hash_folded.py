@@ -358,9 +358,17 @@ def make_folded_step(cfg):
                     jnp.where(ack_send, tgt1, n).reshape(-1)].add(
                         1, mode="drop")[:n]
             else:
-                in_flight = psum_row(v1.astype(I32))
-                recv_probe = in_flight * p_red
-                sent_ack = in_flight
+                # Approximate per-node split, exact totals — the filters
+                # of tpu_hash.make_step's scale branch on folded planes
+                # (see _will_flush / _credit_orphan_recvs there).
+                from distributed_membership_tpu.backends.tpu_hash import (
+                    _credit_orphan_recvs, _will_flush)
+                will_flush = _will_flush(recv_mask, fail_mask, t,
+                                         fail_time)
+                per_prober = psum_row(
+                    (v1 & will_flush[tgt1]).astype(I32)) * p_red
+                recv_probe = _credit_orphan_recvs(per_prober, will_flush)
+                sent_ack = psum_row((v1 & act[tgt1]).astype(I32))
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
@@ -564,7 +572,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
                 jnp.stack([s2 for _, _, _, s2 in stacked]))
         sent_tick = sent_gossip
 
-        # ---- probe issue (P-folded, shared; prober attribution) ----
+        # ---- probe issue (P-folded, shared) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if p_cnt > 0:
@@ -576,9 +584,41 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
             act_prev = act
             psum_row = lambda x: _sumP(x, n_local, fp, p_cnt)  # noqa: E731
             sent_probes = psum_row(p_valid.astype(I32)) * p_red
-            in_flight = psum_row((state.probe_ids1 > 0).astype(I32))
-            sent_tick = sent_tick + sent_probes + in_flight
-            recv_add = recv_add + in_flight * p_red + ack_recv_cnt
+            # Counter attribution: the folded twin of the natural sharded
+            # step's exact/approx branches (tpu_hash_sharded
+            # make_ring_sharded_step — same expressions on P-folded
+            # planes, so the two runs stay bit-exact).
+            ids1 = state.probe_ids1
+            v1 = ids1 > 0
+            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)    # global target ids
+            act_g = lax.all_gather(act, NODE_AXIS, tiled=True)      # [N]
+            ack_send = v1 & act_g[tgt1]
+            if cfg.count_probe_io:
+                recv_hist = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(v1, tgt1, n).reshape(-1)].add(
+                        p_red, mode="drop")[:n]
+                ack_hist = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(ack_send, tgt1, n).reshape(-1)].add(
+                        1, mode="drop")[:n]
+                recv_probe = lax.psum_scatter(
+                    recv_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                sent_ack = lax.psum_scatter(
+                    ack_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+            else:
+                from distributed_membership_tpu.backends.tpu_hash import (
+                    _credit_orphan_recvs_sharded, _will_flush)
+                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                           fail_time)
+                will_flush_g = lax.all_gather(
+                    will_flush_l, NODE_AXIS, tiled=True)            # [N]
+                per_prober = psum_row(
+                    (v1 & will_flush_g[tgt1]).astype(I32)) * p_red
+                recv_probe = _credit_orphan_recvs_sharded(
+                    per_prober, will_flush_l, will_flush_g, lrows,
+                    NODE_AXIS)
+                sent_ack = psum_row(ack_send.astype(I32))
+            sent_tick = sent_tick + sent_probes + sent_ack
+            recv_add = recv_add + recv_probe + ack_recv_cnt
 
         pending_recv = pending_recv + recv_add
         failed = state.failed | (fail_mask_l & (t == fail_time))

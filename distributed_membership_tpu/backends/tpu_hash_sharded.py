@@ -66,8 +66,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_hash import (
-    STRIDE, HashConfig, I32, U32, make_admit, make_config, pack, slot_of,
-    unpack)
+    STRIDE, HashConfig, I32, U32, _credit_orphan_recvs_sharded,
+    _will_flush, make_admit, make_config, pack, slot_of, unpack)
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
 from distributed_membership_tpu.config import Params
@@ -201,9 +201,13 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
 
     Probes/acks use `tpu_hash`'s gather pipeline with one [N] ``all_gather``
     of the lagged heartbeat vector per tick (4 MB at N=1M — the whole
-    cross-shard probe subsystem).  Per-node probe counters use prober
-    attribution (per-target attribution would need [N] psums per tick);
-    totals remain comparable.
+    cross-shard probe subsystem).  Per-node probe counters follow
+    ``cfg.count_probe_io``: exact per-target attribution builds two local
+    [N]-index histograms and ``psum_scatter``s them back to their owner
+    shards (plus one bool act all_gather) — the same wire the ack
+    pipeline's [N] all_gather already rides; approx mode charges probe
+    traffic to the prober's row with exact totals (the ack count keeps
+    the act-of-target filter via the gathered act vector).
 
     With ``cold_join`` the full join handshake runs
     (MP1Node.cpp:126-163,226-251 semantics, as the single-chip ring and
@@ -517,9 +521,45 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             sent_probes = p_valid.sum(1, dtype=I32) * p_red
-            in_flight = (state.probe_ids1 > 0).sum(1, dtype=I32)
-            sent_tick = sent_tick + sent_probes + in_flight
-            recv_add = recv_add + in_flight * p_red + ack_recv_cnt
+            ids1 = state.probe_ids1
+            v1 = ids1 > 0
+            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
+            # act of every node this tick — the exact branch charges ack
+            # sends to targets, and BOTH branches need the act-of-target
+            # filter for exact totals (dead targets send no ack).
+            act_g = lax.all_gather(act, NODE_AXIS, tiled=True)     # [N]
+            ack_send = v1 & act_g[tgt1]
+            if cfg.count_probe_io:
+                # Exact per-target attribution (tpu_hash.make_step's
+                # exact branch, distributed): local histograms over the
+                # GLOBAL index space, summed-and-sliced back to the
+                # owner shards by one psum_scatter each.
+                recv_hist = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(v1, tgt1, n).reshape(-1)].add(
+                        p_red, mode="drop")[:n]
+                ack_hist = jnp.zeros((n + 1,), I32).at[
+                    jnp.where(ack_send, tgt1, n).reshape(-1)].add(
+                        1, mode="drop")[:n]
+                recv_probe = lax.psum_scatter(
+                    recv_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                sent_ack = lax.psum_scatter(
+                    ack_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+            else:
+                # Approximate per-node split, exact totals — the filters
+                # of tpu_hash.make_step's scale branch, distributed
+                # (_will_flush / _credit_orphan_recvs_sharded there).
+                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                           fail_time)
+                will_flush_g = lax.all_gather(
+                    will_flush_l, NODE_AXIS, tiled=True)        # [N]
+                per_prober = (v1 & will_flush_g[tgt1]).sum(
+                    1, dtype=I32) * p_red
+                recv_probe = _credit_orphan_recvs_sharded(
+                    per_prober, will_flush_l, will_flush_g, lrows,
+                    NODE_AXIS)
+                sent_ack = ack_send.sum(1, dtype=I32)
+            sent_tick = sent_tick + sent_probes + sent_ack
+            recv_add = recv_add + recv_probe + ack_recv_cnt
 
         pending_recv = pending_recv + recv_add
         failed = state.failed | (fail_mask_l & (t == fail_time))

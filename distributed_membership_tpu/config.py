@@ -118,6 +118,13 @@ class Params:
     # JOIN_MODE warm, aggregate events, 128 % VIEW_SIZE == 0.  Bit-exact
     # with the natural layout (same seed -> same trajectory).
     FOLDED: int = 0
+    # Per-node attribution of probe-recv / ack-send counters on the
+    # jitted ring paths: 'exact' builds the [N]-index histograms (and,
+    # sharded, the [N] psum_scatter) that charge each message to its
+    # true row at ANY size; 'approx' charges probe traffic to the
+    # prober's row (totals stay exact — tests/test_probe_io.py);
+    # 'auto' picks exact up to tpu_hash.PROBE_IO_EXACT_MAX nodes.
+    PROBE_IO: str = "auto"
     # Enforce EmulNet's bounded send buffer (EN_BUFFSIZE, reference
     # ENBUFFSIZE=30000 with drop-on-full, EmulNet.cpp:92-94) on the
     # tpu_hash ring exchange as a per-tick global send budget: sends are
@@ -194,6 +201,9 @@ class Params:
         if self.EXCHANGE not in ("auto", "scatter", "ring"):
             raise ValueError(
                 f"EXCHANGE must be auto|scatter|ring, got {self.EXCHANGE!r}")
+        if self.PROBE_IO not in ("auto", "exact", "approx"):
+            raise ValueError(
+                f"PROBE_IO must be auto|exact|approx, got {self.PROBE_IO!r}")
         if self.JOIN_MODE == "warm" and self.BACKEND not in (
                 "tpu_sparse", "tpu_hash", "tpu_hash_sharded"):
             # Warm bootstrap needs backend support (pre-seeded views); on the

@@ -250,10 +250,14 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
         start = jax.lax.rem(i * b - rq_j - 1 + rows, rows)
         off = jax.lax.rem(start, b)
         rows2b = jnp.concatenate([plo_ref[0], phi_ref[0]], axis=0)
-        slab = jax.lax.dynamic_slice(rows2b, (off, 0), (b + 1, LANES))
+        # The b+1 sender rows starting at ``off``: Mosaic TC has no
+        # dynamic_slice lowering, so rotate row ``off`` to row 0 (dynamic
+        # sublane roll) and take static slices — as in
+        # fused_gossip._assemble_senders.
+        rolled = pltpu.roll(rows2b, 2 * b - off, axis=0)
         # roll_nodes: a = rows rolled by rq, carry = rolled once more.
-        a = slab[1:]
-        carry = slab[:-1]
+        a = rolled[1:b + 1]
+        carry = rolled[:b]
         lane = jax.lax.broadcasted_iota(I32, (b, LANES), 1)
         x = jnp.where(lane < rr_j, pltpu.roll(carry, rr_j, axis=1),
                       pltpu.roll(a, rr_j, axis=1))

@@ -66,10 +66,17 @@ def _lo_block_idx(i, b: int, rows: int, shift):
 
 
 def _assemble_senders(plo, phi, off, b: int):
-    """Concatenate the two fetched adjacent blocks and slice the B
-    sender rows starting at the in-block offset (in-VMEM dynamic slice)."""
+    """Concatenate the two fetched adjacent blocks and extract the B
+    sender rows starting at in-block offset ``off``.  Mosaic TC has no
+    ``dynamic_slice`` lowering (the real-chip correctness rung caught
+    this — interpret mode accepts it), so the dynamic start is applied
+    as a dynamic sublane rotate (``pltpu.roll`` on axis 0, which Mosaic
+    lowers as tpu.dynamic_rotate) bringing row ``off`` to row 0,
+    followed by a static slice."""
+    from jax.experimental.pallas import tpu as pltpu
+
     rows2b = jnp.concatenate([plo, phi], axis=0)
-    return jax.lax.dynamic_slice_in_dim(rows2b, off, b, axis=0)
+    return pltpu.roll(rows2b, 2 * b - off, axis=0)[:b]
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -176,8 +183,10 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
         r = sh_ref[j]
         off = jax.lax.rem(jax.lax.rem(i * b - r + rows, rows), b)
         senders = _assemble_senders(plo_ref[:], phi_ref[:], off, b)
+        # k_eff rides as [rows, 1] planes (1-D refs can't take the
+        # sublane rotate _assemble_senders needs on the real chip).
         ke = _assemble_senders(klo_ref[:], khi_ref[:], off, b)
-        senders = jnp.where((j < ke)[:, None], senders, U32(0))
+        senders = jnp.where(j < ke, senders, U32(0))
 
         # Column alignment: one shift for all rows (the supported case
         # (N*STRIDE) % S == 0 — see module docstring).
@@ -200,10 +209,10 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
                          (_lo_block(i, j, sh), 0)),                # payload lo
             pl.BlockSpec((b, s), lambda i, j, sh:
                          (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
-            pl.BlockSpec((b,), lambda i, j, sh:
-                         (_lo_block(i, j, sh),)),                  # k_eff lo
-            pl.BlockSpec((b,), lambda i, j, sh:
-                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb),)),
+            pl.BlockSpec((b, 1), lambda i, j, sh:
+                         (_lo_block(i, j, sh), 0)),                # k_eff lo
+            pl.BlockSpec((b, 1), lambda i, j, sh:
+                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
         ],
         out_specs=pl.BlockSpec((b, s), row_block),
     )
@@ -212,5 +221,5 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, s), U32),
         interpret=interpret,
-    )(shifts.astype(I32), mail, payload, payload, k_eff.astype(I32),
-      k_eff.astype(I32))
+    )(shifts.astype(I32), mail, payload, payload,
+      k_eff.astype(I32)[:, None], k_eff.astype(I32)[:, None])

@@ -111,7 +111,18 @@ def test_probe_attribution_exact_flag():
     assert not probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2))
     # Scatter mode and probe-free configs attribute exactly at any N.
     assert probe_attribution_exact(mk(PROBE_IO_EXACT_MAX * 2, "scatter"))
-    # The sharded ring step uses prober attribution at EVERY size.
+    # The sharded ring follows the same size gate since the psum_scatter
+    # histogram path landed (round 4); PROBE_IO overrides it either way.
     sharded = mk(1024)
     sharded.BACKEND = "tpu_hash_sharded"
-    assert not probe_attribution_exact(sharded)
+    assert probe_attribution_exact(sharded)
+    big = mk(PROBE_IO_EXACT_MAX * 2)
+    big.PROBE_IO = "exact"
+    assert probe_attribution_exact(big)
+    small = mk(1024)
+    small.PROBE_IO = "approx"
+    assert not probe_attribution_exact(small)
+    bad = mk(1024)
+    bad.PROBE_IO = "sometimes"
+    with pytest.raises(ValueError, match="PROBE_IO"):
+        bad.validate()
