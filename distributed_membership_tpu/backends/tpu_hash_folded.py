@@ -398,7 +398,8 @@ def make_folded_step(cfg):
     return step
 
 
-def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
+def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
+                                  axes=None, axis_sizes=()):
     """Folded twin of make_ring_sharded_step's warm path
     (tpu_hash_sharded.py): local planes are ``[L/F, 128]``, so the
     per-shift ``ppermute`` moves 1/F the bytes over ICI as well as HBM.
@@ -410,7 +411,9 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
     from distributed_membership_tpu.backends.tpu_hash import (
         STRIDE, HashConfig)
     from distributed_membership_tpu.backends.tpu_hash_sharded import (
-        NODE_AXIS, ShardedHashState)
+        NODE_AXIS, ShardedHashState, make_block_send)
+    if axes is None:
+        axes = (NODE_AXIS,)
     assert isinstance(cfg, HashConfig) and cfg.exchange == "ring"
     n, s, g, p_cnt = cfg.n, cfg.s, cfg.g, cfg.probes
     f = LANES // s
@@ -452,19 +455,13 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
     def rowany(x):
         return x.reshape(lf, f, s).any(-1).reshape(n_local)
 
-    def block_send(tensors, b):
-        def mk(i):
-            if i == 0:
-                return lambda ops: ops
-            perm = [(src, (src + i) % n_shards) for src in range(n_shards)]
-            return lambda ops: tuple(
-                lax.ppermute(o, NODE_AXIS, perm) for o in ops)
-        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+    AX = axes if len(axes) > 1 else axes[0]
+    block_send = make_block_send(n_shards, axes, axis_sizes or (n_shards,))
 
     def step(state, inputs):
         t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = \
             inputs
-        me = lax.axis_index(NODE_AXIS)
+        me = lax.axis_index(AX)
         row0 = (me * n_local).astype(I32)
         lrows = row0 + l_idx
         node = local_node + row0                     # global id / element
@@ -488,7 +485,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
         cand_sf = jnp.zeros((lf, LANES), U32)
         if p_cnt > 0:
             vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)    # [N]
+            vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
             cand_sf, ack_recv_cnt = _fold_ack_candidates(
                 n, s, p_cnt, fp, cand_idx, n_local, t, state.probe_ids2,
                 vec_g, recv_mask, k_ack2, cfg.drop_prob, use_drop,
@@ -591,7 +588,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
             ids1 = state.probe_ids1
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)    # global target ids
-            act_g = lax.all_gather(act, NODE_AXIS, tiled=True)      # [N]
+            act_g = lax.all_gather(act, AX, tiled=True)      # [N]
             ack_send = v1 & act_g[tgt1]
             if cfg.count_probe_io:
                 recv_hist = jnp.zeros((n + 1,), I32).at[
@@ -601,21 +598,21 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
                     jnp.where(ack_send, tgt1, n).reshape(-1)].add(
                         1, mode="drop")[:n]
                 recv_probe = lax.psum_scatter(
-                    recv_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                    recv_hist, AX, scatter_dimension=0, tiled=True)
                 sent_ack = lax.psum_scatter(
-                    ack_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                    ack_hist, AX, scatter_dimension=0, tiled=True)
             else:
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs_sharded, _will_flush)
                 will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
                                            fail_time)
                 will_flush_g = lax.all_gather(
-                    will_flush_l, NODE_AXIS, tiled=True)            # [N]
+                    will_flush_l, AX, tiled=True)            # [N]
                 per_prober = psum_row(
                     (v1 & will_flush_g[tgt1]).astype(I32)) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(
                     per_prober, will_flush_l, will_flush_g, lrows,
-                    NODE_AXIS)
+                    AX)
                 sent_ack = psum_row(ack_send.astype(I32))
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
@@ -631,10 +628,10 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
             sent_tick=sent_tick, recv_tick=recv_tick,
             row_any=rowany, row_expand=rep)
         out = SparseTickEvents(
-            lax.psum(join_mask.sum(dtype=I32), NODE_AXIS),
-            lax.psum((rm_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
-            lax.psum(sent_tick.sum(dtype=I32), NODE_AXIS),
-            lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
+            lax.psum(join_mask.sum(dtype=I32), AX),
+            lax.psum((rm_ids != EMPTY).sum(dtype=I32), AX),
+            lax.psum(sent_tick.sum(dtype=I32), AX),
+            lax.psum(recv_tick.sum(dtype=I32), AX))
 
         new_state = ShardedHashState(
             view, view_ts, state.started, state.in_group, failed,
@@ -646,11 +643,13 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int):
     return step
 
 
-def init_local_state_warm_folded(cfg, n_local: int, key: jax.Array):
+def init_local_state_warm_folded(cfg, n_local: int, key: jax.Array,
+                                 ax=None):
     """Fold of tpu_hash_sharded.init_local_state_warm (pure reshape)."""
     from distributed_membership_tpu.backends.tpu_hash_sharded import (
-        ShardedHashState, init_local_state_warm)
-    st = init_local_state_warm(cfg, n_local, key)
+        NODE_AXIS, ShardedHashState, init_local_state_warm)
+    st = init_local_state_warm(cfg, n_local, key,
+                               ax=NODE_AXIS if ax is None else ax)
     f = LANES // cfg.s
     lf = n_local // f
     probe_shape = ((n_local // (LANES // cfg.probes), LANES)

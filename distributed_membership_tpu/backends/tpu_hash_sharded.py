@@ -146,9 +146,10 @@ def init_local_state(cfg: HashConfig, n_local: int) -> ShardedHashState:
 
 
 def init_local_state_warm(cfg: HashConfig, n_local: int,
-                          key: jax.Array) -> ShardedHashState:
+                          key: jax.Array,
+                          ax=NODE_AXIS) -> ShardedHashState:
     """Warm bootstrap of the local rows (cf. tpu_hash.init_state_warm)."""
-    me = lax.axis_index(NODE_AXIS)
+    me = lax.axis_index(ax)
     lrows = me * n_local + jnp.arange(n_local, dtype=I32)
     st = init_local_state(cfg, n_local)
     fill = max(cfg.s // 2, 1)
@@ -185,8 +186,77 @@ def bucket_capacity(cfg: HashConfig, n_local: int, n_shards: int) -> int:
     return min(cap, n_local * per_sender + seed_total)
 
 
+def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
+    """Build the block-shift router: route tensors to shard ``me + b``
+    (flat shard index), ``lax.switch`` over D static permutations since
+    ``b`` is traced but replicated.
+
+    On a 1-D mesh each branch is one ``ppermute`` rotation.  On a 2-D
+    torus mesh (axes ``(outer, inner)``, flat = o*DI + i) the flat shift
+    ``b`` decomposes into per-axis ring rotations — the hops every torus
+    interconnect implements natively — instead of asking the router for
+    an arbitrary flat permutation: rotate the inner ring by ``r = b % DI``,
+    then the outer ring by ``q = b // DI`` for payloads whose inner index
+    did not wrap and ``q + 1`` for those that did.  The wrap set is
+    per-shard static after stage 1 (destination inner index < r), so
+    stage 2 is two masked outer rotations combined by that select; inner
+    wire cost is one payload, outer is two (one mostly-zero) — still
+    neighbor-hop traffic on both ICI dimensions vs. a D-way flat
+    permutation."""
+    if len(axis_sizes) != len(axes):
+        raise ValueError(
+            f"axis_sizes {axis_sizes} must match axes {axes} — pass one "
+            "size per mesh axis (the 2-D decomposition needs both)")
+    if len(axes) == 1:
+        ax = axes[0]
+
+        def block_send(tensors, b):
+            def mk(i):
+                if i == 0:
+                    return lambda ops: ops
+                perm = [(src, (src + i) % n_shards)
+                        for src in range(n_shards)]
+                return lambda ops: tuple(
+                    lax.ppermute(o, ax, perm) for o in ops)
+            return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+        return block_send
+
+    ao, ai = axes
+    do, di = axis_sizes
+    assert do * di == n_shards
+
+    def block_send(tensors, b):
+        def mk(i):
+            if i == 0:
+                return lambda ops: ops
+            q, r = divmod(i, di)
+            perm_i = [(src, (src + r) % di) for src in range(di)]
+            perm_q = [(src, (src + q) % do) for src in range(do)]
+            perm_q1 = [(src, (src + q + 1) % do) for src in range(do)]
+
+            def go(ops):
+                if r == 0:
+                    # Pure outer rotation (q > 0 since i > 0).
+                    return tuple(lax.ppermute(o, ao, perm_q) for o in ops)
+                ops = tuple(lax.ppermute(o, ai, perm_i) for o in ops)
+                carried = lax.axis_index(ai) < r
+
+                def hop(o):
+                    z = jnp.zeros_like(o)
+                    stay = jnp.where(carried, z, o)
+                    a = (lax.ppermute(stay, ao, perm_q) if q else stay)
+                    c = lax.ppermute(jnp.where(carried, o, z), ao, perm_q1)
+                    return jnp.where(carried, c, a)
+                return tuple(hop(o) for o in ops)
+            return go
+        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+    return block_send
+
+
 def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
-                           cold_join: bool = False):
+                           cold_join: bool = False,
+                           axes: tuple = (NODE_AXIS,),
+                           axis_sizes: tuple = ()):
     """Ring exchange on the sharded backend (EXCHANGE ring).
 
     Gossip shifts are torus-product translations ``(j, d) -> (j+c, d+b)``
@@ -239,20 +309,16 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
     if cfg.probes >= s:
         raise ValueError("ring mode needs PROBES < VIEW_SIZE "
                          f"(got {cfg.probes} >= {s})")
-
-    def block_send(tensors, b):
-        """Route tensors to shard (me + b) — switch over D static perms."""
-        def mk(i):
-            if i == 0:
-                return lambda ops: ops
-            perm = [(src, (src + i) % n_shards) for src in range(n_shards)]
-            return lambda ops: tuple(
-                lax.ppermute(o, NODE_AXIS, perm) for o in ops)
-        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+    # AX feeds every whole-axis collective; a tuple of axis names has the
+    # flattened-mesh semantics (outer-major), so the protocol below is
+    # mesh-shape-agnostic — only block_send decomposes per axis.
+    AX = axes if len(axes) > 1 else axes[0]
+    block_send = make_block_send(n_shards, axes,
+                                 axis_sizes or (n_shards,))
 
     def step(state: ShardedHashState, inputs):
         t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = inputs
-        me = lax.axis_index(NODE_AXIS)
+        me = lax.axis_index(AX)
         row0 = (me * n_local).astype(I32)
         lrows = row0 + l_idx
         fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
@@ -287,7 +353,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             in_group = state.in_group | (state.joinrep_infl & recv_mask)
             joinrep_infl = state.joinrep_infl & ~recv_mask
 
-            joinreq_g = lax.all_gather(state.joinreq_infl, NODE_AXIS,
+            joinreq_g = lax.all_gather(state.joinreq_infl, AX,
                                        tiled=True)
             seeds_g = joinreq_g & intro_recv
             joinreq_infl = state.joinreq_infl & ~intro_recv
@@ -325,7 +391,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # one [N] all_gather of the lagged heartbeat vector is the
             # whole cross-shard probe subsystem.
             vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            vec_g = lax.all_gather(vec_l, NODE_AXIS, tiled=True)     # [N]
+            vec_g = lax.all_gather(vec_l, AX, tiled=True)     # [N]
             ids2 = state.probe_ids2
             id2 = jnp.clip(ids2.astype(I32) - 1, 0)
             hb_ack = vec_g[id2]
@@ -470,9 +536,9 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # owns.  Burst drop coins come from a replicated stream so the
             # sender-side counter and receiver-side delivery agree.
             row_view = lax.psum(
-                jnp.where(intro_here, view[intro_local], U32(0)), NODE_AXIS)
+                jnp.where(intro_here, view[intro_local], U32(0)), AX)
             row_ts = lax.psum(
-                jnp.where(intro_here, view_ts[intro_local], 0), NODE_AXIS)
+                jnp.where(intro_here, view_ts[intro_local], 0), AX)
             b_id, b_hb, b_present = unpack(cfg, row_view)
             b_fresh = b_present & ((t - row_ts) < cfg.tfail)
             cap = min(cfg.seed_cap, n)
@@ -527,7 +593,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # act of every node this tick — the exact branch charges ack
             # sends to targets, and BOTH branches need the act-of-target
             # filter for exact totals (dead targets send no ack).
-            act_g = lax.all_gather(act, NODE_AXIS, tiled=True)     # [N]
+            act_g = lax.all_gather(act, AX, tiled=True)     # [N]
             ack_send = v1 & act_g[tgt1]
             if cfg.count_probe_io:
                 # Exact per-target attribution (tpu_hash.make_step's
@@ -541,9 +607,9 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                     jnp.where(ack_send, tgt1, n).reshape(-1)].add(
                         1, mode="drop")[:n]
                 recv_probe = lax.psum_scatter(
-                    recv_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                    recv_hist, AX, scatter_dimension=0, tiled=True)
                 sent_ack = lax.psum_scatter(
-                    ack_hist, NODE_AXIS, scatter_dimension=0, tiled=True)
+                    ack_hist, AX, scatter_dimension=0, tiled=True)
             else:
                 # Approximate per-node split, exact totals — the filters
                 # of tpu_hash.make_step's scale branch, distributed
@@ -551,12 +617,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
                                            fail_time)
                 will_flush_g = lax.all_gather(
-                    will_flush_l, NODE_AXIS, tiled=True)        # [N]
+                    will_flush_l, AX, tiled=True)        # [N]
                 per_prober = (v1 & will_flush_g[tgt1]).sum(
                     1, dtype=I32) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(
                     per_prober, will_flush_l, will_flush_g, lrows,
-                    NODE_AXIS)
+                    AX)
                 sent_ack = ack_send.sum(1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
@@ -583,10 +649,10 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                     sent_tick=sent_tick, recv_tick=recv_tick,
                     holder_failed=fail_mask_l)
             out = SparseTickEvents(
-                lax.psum((join_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
-                lax.psum((rm_ids != EMPTY).sum(dtype=I32), NODE_AXIS),
-                lax.psum(sent_tick.sum(dtype=I32), NODE_AXIS),
-                lax.psum(recv_tick.sum(dtype=I32), NODE_AXIS))
+                lax.psum((join_ids != EMPTY).sum(dtype=I32), AX),
+                lax.psum((rm_ids != EMPTY).sum(dtype=I32), AX),
+                lax.psum(sent_tick.sum(dtype=I32), AX),
+                lax.psum(recv_tick.sum(dtype=I32), AX))
 
         new_state = ShardedHashState(
             view, view_ts, started, in_group, failed, self_hb,
@@ -939,37 +1005,37 @@ def boolean_any(x: jax.Array) -> jax.Array:
     return x.any()
 
 
-def reduce_fast_agg(agg: FastAgg) -> FastAgg:
+def reduce_fast_agg(agg: FastAgg, ax=NODE_AXIS) -> FastAgg:
     """Reduce per-shard FastAgg partials to the replicated global value."""
     return FastAgg(
-        det_count=lax.psum(agg.det_count, NODE_AXIS),
-        trackers=lax.psum(agg.trackers, NODE_AXIS),
-        tracker_obs=lax.all_gather(agg.tracker_obs, NODE_AXIS, tiled=True),
-        det_obs=lax.all_gather(agg.det_obs, NODE_AXIS, tiled=True),
-        lat_hist=lax.psum(agg.lat_hist, NODE_AXIS),
-        join_total=lax.psum(agg.join_total, NODE_AXIS),
-        rm_total=lax.psum(agg.rm_total, NODE_AXIS),
-        sent_total=lax.all_gather(agg.sent_total, NODE_AXIS, tiled=True),
-        recv_total=lax.all_gather(agg.recv_total, NODE_AXIS, tiled=True),
+        det_count=lax.psum(agg.det_count, ax),
+        trackers=lax.psum(agg.trackers, ax),
+        tracker_obs=lax.all_gather(agg.tracker_obs, ax, tiled=True),
+        det_obs=lax.all_gather(agg.det_obs, ax, tiled=True),
+        lat_hist=lax.psum(agg.lat_hist, ax),
+        join_total=lax.psum(agg.join_total, ax),
+        rm_total=lax.psum(agg.rm_total, ax),
+        sent_total=lax.all_gather(agg.sent_total, ax, tiled=True),
+        recv_total=lax.all_gather(agg.recv_total, ax, tiled=True),
     )
 
 
-def reduce_agg(agg: AggStats) -> AggStats:
+def reduce_agg(agg: AggStats, ax=NODE_AXIS) -> AggStats:
     """Reduce per-shard agg partials to the replicated global AggStats:
     psum for counts/histogram, pmin/pmax for first/last ticks, all_gather
     for observer-row-indexed fields."""
     return AggStats(
-        rm_count=lax.psum(agg.rm_count, NODE_AXIS),
-        det_count=lax.psum(agg.det_count, NODE_AXIS),
-        rm_first=lax.pmin(agg.rm_first, NODE_AXIS),
-        rm_last=lax.pmax(agg.rm_last, NODE_AXIS),
-        join_count=lax.psum(agg.join_count, NODE_AXIS),
-        trackers=lax.psum(agg.trackers, NODE_AXIS),
-        tracker_obs=lax.all_gather(agg.tracker_obs, NODE_AXIS, tiled=True),
-        det_obs=lax.all_gather(agg.det_obs, NODE_AXIS, tiled=True),
-        lat_hist=lax.psum(agg.lat_hist, NODE_AXIS),
-        sent_total=lax.all_gather(agg.sent_total, NODE_AXIS, tiled=True),
-        recv_total=lax.all_gather(agg.recv_total, NODE_AXIS, tiled=True),
+        rm_count=lax.psum(agg.rm_count, ax),
+        det_count=lax.psum(agg.det_count, ax),
+        rm_first=lax.pmin(agg.rm_first, ax),
+        rm_last=lax.pmax(agg.rm_last, ax),
+        join_count=lax.psum(agg.join_count, ax),
+        trackers=lax.psum(agg.trackers, ax),
+        tracker_obs=lax.all_gather(agg.tracker_obs, ax, tiled=True),
+        det_obs=lax.all_gather(agg.det_obs, ax, tiled=True),
+        lat_hist=lax.psum(agg.lat_hist, ax),
+        sent_total=lax.all_gather(agg.sent_total, ax, tiled=True),
+        recv_total=lax.all_gather(agg.recv_total, ax, tiled=True),
     )
 
 
@@ -979,19 +1045,30 @@ _RUNNER_CACHE: dict = {}
 def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     cache_key = (cfg, n_local, mesh, warm)
     if cache_key not in _RUNNER_CACHE:
-        n_shards = mesh.shape[NODE_AXIS]
+        axes = tuple(mesh.axis_names)
+        axis_sizes = tuple(mesh.shape[a] for a in axes)
+        n_shards = int(np.prod(axis_sizes))
+        AX = axes if len(axes) > 1 else axes[0]
         ring = cfg.exchange == "ring"
+        if len(axes) > 1 and not ring:
+            raise ValueError(
+                "2-D torus meshes require EXCHANGE ring (the bucketed "
+                "all_to_all exchange is 1-D only)")
         if cfg.folded:
             from distributed_membership_tpu.backends.tpu_hash_folded import (
                 init_local_state_warm_folded, make_ring_sharded_folded_step)
-            step = make_ring_sharded_folded_step(cfg, n_local, n_shards)
+            step = make_ring_sharded_folded_step(cfg, n_local, n_shards,
+                                                 axes=axes,
+                                                 axis_sizes=axis_sizes)
             init = lambda k: init_local_state_warm_folded(  # noqa: E731
-                cfg, n_local, k)
+                cfg, n_local, k, ax=AX)
         else:
             step = (make_ring_sharded_step(cfg, n_local, n_shards,
-                                           cold_join=not warm) if ring
+                                           cold_join=not warm, axes=axes,
+                                           axis_sizes=axis_sizes) if ring
                     else make_sharded_step(cfg, n_local, n_shards))
-            init = lambda k: (init_local_state_warm(cfg, n_local, k)  # noqa: E731
+            init = lambda k: (init_local_state_warm(cfg, n_local, k,  # noqa: E731
+                                                    ax=AX)
                               if warm else init_local_state(cfg, n_local))
 
         def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
@@ -1007,21 +1084,22 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
             if not cfg.collect_events:
                 final_state = final_state._replace(
                     agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
-                        final_state.agg))
+                        final_state.agg, ax=AX))
             return final_state, out
 
         # The reduced (or untouched-zero) agg is replicated; everything
-        # else is node-sharded.
+        # else is node-sharded (over BOTH axes when the mesh is 2-D —
+        # P(axes-tuple) is the outer-major flattening AX flattens to).
         agg_t = FastAgg if cfg.fast_agg else AggStats
         agg_spec = agg_t(*(P() for _ in agg_t._fields))
         state_spec = ShardedHashState(
-            **{f: (agg_spec if f == "agg" else P(NODE_AXIS))
+            **{f: (agg_spec if f == "agg" else P(axes))
                for f in ShardedHashState._fields})
         if cfg.collect_events:
             out_spec = SparseTickEvents(
-                join_ids=P(None, NODE_AXIS, None),
-                rm_ids=P(None, NODE_AXIS, None),
-                sent=P(None, NODE_AXIS), recv=P(None, NODE_AXIS))
+                join_ids=P(None, axes, None),
+                rm_ids=P(None, axes, None),
+                sent=P(None, axes), recv=P(None, axes))
         else:
             out_spec = SparseTickEvents(P(None), P(None), P(None), P(None))
 
@@ -1039,7 +1117,7 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                      mesh: Mesh, collect_events: bool = True,
                      total_time: Optional[int] = None):
     n = params.EN_GPSZ
-    d = mesh.shape[NODE_AXIS]
+    d = mesh.size
     if n % d != 0:
         raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
     n_local = n // d
@@ -1105,9 +1183,16 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
     plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     if mesh is None:
-        n_dev = len(jax.devices())
-        d = max(x for x in range(1, n_dev + 1) if params.EN_GPSZ % x == 0)
-        mesh = make_mesh(d)
+        if params.MESH_SHAPE:
+            from distributed_membership_tpu.parallel.mesh import make_mesh2d
+            dims = [int(x) for x in params.MESH_SHAPE.lower().split("x")]
+            mesh = (make_mesh(dims[0]) if len(dims) == 1
+                    else make_mesh2d(*dims))
+        else:
+            n_dev = len(jax.devices())
+            d = max(x for x in range(1, n_dev + 1)
+                    if params.EN_GPSZ % x == 0)
+            mesh = make_mesh(d)
 
     def run_scan_bound(params, plan, seed, collect_events=True,
                        total_time=None):
@@ -1116,5 +1201,5 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
                                 total_time=total_time)
 
     result = finish_run(params, plan, log, run_scan_bound, t0, seed)
-    result.extra["mesh_size"] = mesh.shape[NODE_AXIS]
+    result.extra["mesh_size"] = mesh.size
     return result

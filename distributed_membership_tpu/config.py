@@ -118,6 +118,11 @@ class Params:
     # JOIN_MODE warm, aggregate events, 128 % VIEW_SIZE == 0.  Bit-exact
     # with the natural layout (same seed -> same trajectory).
     FOLDED: int = 0
+    # Device-mesh shape for the sharded backends: '' = auto (largest
+    # 1-D mesh dividing the node count), 'D' = 1-D over D devices,
+    # 'OxI' = 2-D torus (outer x inner; ring exchange only — the block
+    # shifts decompose into per-axis ICI rotations, parallel/mesh.py).
+    MESH_SHAPE: str = ""
     # Per-node attribution of probe-recv / ack-send counters on the
     # jitted ring paths: 'exact' builds the [N]-index histograms (and,
     # sharded, the [N] psum_scatter) that charge each message to its
@@ -204,6 +209,20 @@ class Params:
         if self.PROBE_IO not in ("auto", "exact", "approx"):
             raise ValueError(
                 f"PROBE_IO must be auto|exact|approx, got {self.PROBE_IO!r}")
+        if self.MESH_SHAPE:
+            parts = self.MESH_SHAPE.lower().split("x")
+            if not (1 <= len(parts) <= 2
+                    and all(p.isdigit() and int(p) > 0 for p in parts)):
+                raise ValueError(
+                    f"MESH_SHAPE must be 'D' or 'OxI' (positive ints), "
+                    f"got {self.MESH_SHAPE!r}")
+            if self.BACKEND != "tpu_hash_sharded":
+                # Only the flagship sharded backend reads the key; the
+                # others build their own auto mesh and would silently run
+                # on a different shape than requested.
+                raise ValueError(
+                    "MESH_SHAPE is only supported by BACKEND "
+                    f"tpu_hash_sharded (got {self.BACKEND!r})")
         if self.JOIN_MODE == "warm" and self.BACKEND not in (
                 "tpu_sparse", "tpu_hash", "tpu_hash_sharded"):
             # Warm bootstrap needs backend support (pre-seeded views); on the

@@ -2,9 +2,25 @@
 
 The reference's "distribution" is N logical peers multiplexed in one process
 (SURVEY.md §2); the rebuild's real distribution axis is the *node* axis: the
-``[N, ...]`` protocol state is sharded row-wise over a 1-D mesh, gossip
-between co-located nodes stays on-chip, and cross-shard gossip rides ICI via
-the collectives in :mod:`distributed_membership_tpu.parallel.collectives`.
+``[N, ...]`` protocol state is sharded row-wise over a mesh, gossip between
+co-located nodes stays on-chip, and cross-shard gossip rides ICI via the
+collectives in :mod:`distributed_membership_tpu.parallel.collectives`.
+
+Two mesh shapes:
+
+* 1-D (:func:`make_mesh`) — the default; shard ``d`` owns contiguous rows
+  ``[d*L, (d+1)*L)`` and the ring exchange's block shifts are single
+  ``ppermute`` rotations over the one axis.
+* 2-D torus (:func:`make_mesh2d`) — for slices whose physical ICI topology
+  is a torus (a v4-32 is 4x4x2; larger slices 3-D).  The node axis is
+  sharded over BOTH axes, outer-major: shard ``(o, i)`` holds flat index
+  ``o*DI + i``.  Collectives that read the whole axis (``all_gather``,
+  ``psum``, ``psum_scatter``, ``axis_index``) take the axis-name TUPLE and
+  behave exactly as on the flattened 1-D mesh, so the protocol code is
+  shape-agnostic; the ring exchange's block shift decomposes into per-axis
+  rotations (see tpu_hash_sharded ``block_send``) so every hop moves
+  payloads between physical torus neighbors instead of asking the router
+  to realize an arbitrary flat permutation.
 """
 
 from __future__ import annotations
@@ -14,10 +30,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
+# 2-D torus axis names (outer-major flattening: flat = o * DI + i).
+NODE_OUTER = "nodes_o"
+NODE_INNER = "nodes_i"
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
-    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+def _take_devices(n_devices: int | None):
     devices = jax.devices()
     if n_devices is not None:
         if len(devices) < n_devices:
@@ -25,16 +43,34 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
                 f"need {n_devices} devices, have {len(devices)} "
                 f"(set --xla_force_host_platform_device_count for CPU testing)")
         devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (NODE_AXIS,))
+    return devices
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    return Mesh(np.asarray(_take_devices(n_devices)), (NODE_AXIS,))
+
+
+def make_mesh2d(outer: int, inner: int) -> Mesh:
+    """A 2-D ``outer x inner`` torus mesh over the first outer*inner
+    devices.  On real hardware pass the slice's physical topology so the
+    per-axis ring rotations ride each ICI dimension's links; on the
+    virtual CPU mesh any factorization exercises the same program."""
+    devices = _take_devices(outer * inner)
+    return Mesh(np.asarray(devices).reshape(outer, inner),
+                (NODE_OUTER, NODE_INNER))
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard axis 0 (the node axis) over the mesh."""
-    return NamedSharding(mesh, P(NODE_AXIS))
+    """Shard axis 0 (the node axis) over the mesh (both axes if 2-D).
+
+    ``mesh.axis_names`` / ``mesh.size`` are the idiomatic accessors for
+    the axis tuple and total device count — no wrappers needed."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
 
 
 def check_divisible(n: int, mesh: Mesh) -> int:
-    s = mesh.shape[NODE_AXIS]
-    if n % s != 0:
-        raise ValueError(f"node count {n} must be divisible by mesh size {s}")
-    return n // s
+    if n % mesh.size != 0:
+        raise ValueError(
+            f"node count {n} must be divisible by mesh size {mesh.size}")
+    return n // mesh.size
