@@ -82,19 +82,24 @@ def leg_hash(n: int, ticks: int, pin: str | None,
     s = view or int(os.environ.get("BENCH_VIEW", "128"))
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
-    # BENCH_FUSED=recv|gossip|both turns on the Pallas kernels (ring mode,
-    # S % 128 == 0; see PERF.md) — off by default until the correctness
-    # rung has passed on hardware.
-    fused = os.environ.get("BENCH_FUSED", "off")
-    if fused not in ("off", "recv", "gossip", "both"):
-        raise SystemExit(f"BENCH_FUSED must be off|recv|gossip|both, "
+    # BENCH_FUSED=recv|gossip|both pins the Pallas kernels on, off pins
+    # them off; the default 'auto' (-1 conf keys) lets the fusegate
+    # enable whatever the banked hardware-correctness record has cleared
+    # (runtime/fusegate.py) — so the bench picks up the fast paths the
+    # moment the chip has proven them, and never ships an unproven one.
+    fused = os.environ.get("BENCH_FUSED", "auto")
+    if fused not in ("auto", "off", "recv", "gossip", "both"):
+        raise SystemExit(f"BENCH_FUSED must be auto|off|recv|gossip|both, "
                          f"got {fused!r}")
-    folded = os.environ.get("BENCH_FOLDED", "off")
-    if folded not in ("off", "on"):
-        raise SystemExit(f"BENCH_FOLDED must be off|on, got {folded!r}")
-    fused_keys = (f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
-                  f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n"
-                  f"FOLDED: {int(folded == 'on')}\n")
+    folded = os.environ.get("BENCH_FOLDED", "auto")
+    if folded not in ("auto", "off", "on"):
+        raise SystemExit(f"BENCH_FOLDED must be auto|off|on, got {folded!r}")
+    fused_keys = (
+        ("FUSED_RECEIVE: -1\nFUSED_GOSSIP: -1\n" if fused == "auto" else
+         f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
+         f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n")
+        + ("FOLDED: -1\n" if folded == "auto" else
+           f"FOLDED: {int(folded == 'on')}\n"))
     params = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
@@ -316,9 +321,13 @@ def main() -> int:
     # duplicate the first (BENCH_VIEW=16) or reject its config
     # (BENCH_FUSED kernels need S % 128 == 0 unless composed with
     # BENCH_FOLDED, whose folded twins take S < 128).
+    # (auto FUSED never rejects a config — the fusegate falls back to
+    # the jnp path.  A PINNED-on kernel at S=16 is only safe when FOLDED
+    # is pinned on too: auto-folded may resolve off, stranding the
+    # pinned kernel at an incompatible S.)
     want_s16 = (int(os.environ.get("BENCH_VIEW", "128")) != 16
-                and (os.environ.get("BENCH_FUSED", "off") == "off"
-                     or os.environ.get("BENCH_FOLDED", "off") == "on"))
+                and (os.environ.get("BENCH_FUSED", "auto") in ("off", "auto")
+                     or os.environ.get("BENCH_FOLDED", "auto") == "on"))
 
     if on_accel:
         # The TPU relay here can serve one run and then WEDGE on the next

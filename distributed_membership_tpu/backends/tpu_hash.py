@@ -864,13 +864,65 @@ def make_config(params: Params, collect_events: bool = True,
     # and does F elementwise passes per tick (observability/aggregates.py).
     fast_agg = (not collect_events and exchange == "ring"
                 and len(fail_ids) <= FAST_AGG_MAX_FAILED)
-    fused = bool(params.FUSED_RECEIVE)
+    send_budget_req = params.EN_BUFFSIZE if params.ENFORCE_BUFFSIZE else 0
+    # --- resolve the -1 (auto) fast-path knobs --------------------------
+    # Auto turns a path on only when the process runs on a real TPU, the
+    # config structurally supports it (same predicates the explicit-1
+    # branches below enforce loudly), and the chip has banked bit-exact
+    # evidence for the family (runtime/fusegate.py; fail closed).  Auto
+    # never raises — an unsupported config quietly keeps the jnp path.
+    fr_knob, fg_knob = params.FUSED_RECEIVE, params.FUSED_GOSSIP
+    fold_knob = params.FOLDED
+    if -1 in (fr_knob, fg_knob, fold_knob):
+        from distributed_membership_tpu.backends.tpu_hash_folded import (
+            folded_supported)
+        from distributed_membership_tpu.runtime.fusegate import (
+            banked_correctness, families_clean, on_tpu)
+        # Auto enables only what the banked evidence actually proves:
+        # scripts/tpu_correctness.py runs BACKEND tpu_hash single-chip,
+        # so the sharded backend's shard_map lowering (different Mosaic
+        # elaboration over local rows) is NOT covered — its auto knobs
+        # stay off until a sharded correctness arm exists.  Explicit 1
+        # remains available there (validated per-shard, loudly).
+        eligible = on_tpu() and params.BACKEND == "tpu_hash"
+        rec = banked_correctness() if eligible else None
+        cleared = lambda *fams: families_clean(rec, *fams)  # noqa: E731
+        if fold_knob == -1:
+            fold_knob = int(
+                eligible and exchange == "ring"
+                and params.JOIN_MODE == "warm" and fast_agg
+                and folded_supported(n, s, params.PROBES)
+                and send_budget_req == 0
+                and cleared(f"folded_s{s}"))
+        if fold_knob and 0 < s < 128:
+            # Folded planes: the fused twins ship as one pair, gated on
+            # the folded_fused family at this fold factor.
+            kernels_ok = (eligible and (n * s) // 128 >= 8
+                          and cleared(f"folded_fused_s{s}"))
+            if fr_knob == -1:
+                fr_knob = int(kernels_ok)
+            if fg_knob == -1:
+                fg_knob = int(kernels_ok)
+        else:
+            if fr_knob == -1:
+                fr_knob = int(
+                    eligible and exchange == "ring"
+                    and fused_supported(n, s)
+                    and cleared("fused_receive", "fused_both"))
+            if fg_knob == -1:
+                fg_knob = int(
+                    eligible and exchange == "ring"
+                    and gossip_fused_supported(n, s)
+                    and params.effective_drop_prob() == 0
+                    and send_budget_req == 0
+                    and cleared("fused_gossip", "fused_both"))
+    fused = bool(fr_knob)
     if fused and exchange != "ring":
         raise ValueError("FUSED_RECEIVE requires the ring exchange")
-    fused_g = bool(params.FUSED_GOSSIP)
+    fused_g = bool(fg_knob)
     if fused_g and exchange != "ring":
         raise ValueError("FUSED_GOSSIP requires the ring exchange")
-    folded = bool(params.FOLDED)
+    folded = bool(fold_knob)
     if folded:
         from distributed_membership_tpu.backends.tpu_hash_folded import (
             folded_supported)
@@ -914,7 +966,7 @@ def make_config(params: Params, collect_events: bool = True,
                 "draws a fresh per-shift drop mask the kernel cannot "
                 "replicate bit-exactly); the FOLDED stacked kernel "
                 "supports drops")
-    send_budget = params.EN_BUFFSIZE if params.ENFORCE_BUFFSIZE else 0
+    send_budget = send_budget_req
     if send_budget:
         if exchange != "ring":
             raise ValueError(

@@ -106,18 +106,24 @@ class Params:
     # Run the ring receive pass as one Pallas kernel (ops/fused_receive)
     # instead of the fused-by-XLA jnp expression.  Requires EXCHANGE ring
     # and VIEW_SIZE % 128 == 0; interpret-mode fallback off-TPU.
-    FUSED_RECEIVE: int = 0
+    # 1 = on (structural violations raise), 0 = off, -1 = auto: on IFF
+    # the process resolved to a real TPU, the config structurally
+    # supports the kernel, AND the chip has a banked bit-exactness
+    # verdict for the family (runtime/fusegate.py — fail closed).
+    FUSED_RECEIVE: int = -1
     # Deliver all circulant gossip shifts in one Pallas traversal
     # (ops/fused_gossip) instead of fanout separate roll+max passes.
     # Requires EXCHANGE ring, VIEW_SIZE % 128 == 0, N a multiple of the
     # view size ((N*STRIDE) % S == 0), and a drop-free config.
-    FUSED_GOSSIP: int = 0
+    # 1/0/-1 as FUSED_RECEIVE (auto gated on banked chip evidence).
+    FUSED_GOSSIP: int = -1
     # Folded [N/F, 128] physical layout for VIEW_SIZE < 128 (F = 128/S):
     # removes the 128-lane padding that costs the S=16 regime ~8x HBM on
     # TPU (backends/tpu_hash_folded.py).  Requires EXCHANGE ring,
     # JOIN_MODE warm, aggregate events, 128 % VIEW_SIZE == 0.  Bit-exact
     # with the natural layout (same seed -> same trajectory).
-    FOLDED: int = 0
+    # 1/0/-1 as FUSED_RECEIVE (auto gated on banked chip evidence).
+    FOLDED: int = -1
     # Device-mesh shape for the sharded backends: '' = auto (largest
     # 1-D mesh dividing the node count), 'D' = 1-D over D devices,
     # 'OxI' = 2-D torus (outer x inner; ring exchange only — the block
@@ -209,6 +215,11 @@ class Params:
         if self.PROBE_IO not in ("auto", "exact", "approx"):
             raise ValueError(
                 f"PROBE_IO must be auto|exact|approx, got {self.PROBE_IO!r}")
+        for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
+            if getattr(self, knob) not in (-1, 0, 1):
+                raise ValueError(
+                    f"{knob} must be 1 (on), 0 (off) or -1 (auto), got "
+                    f"{getattr(self, knob)!r}")
         if self.MESH_SHAPE:
             parts = self.MESH_SHAPE.lower().split("x")
             if not (1 <= len(parts) <= 2

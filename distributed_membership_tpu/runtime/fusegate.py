@@ -1,0 +1,81 @@
+"""Auto-enable gate for the Pallas/folded fast paths (VERDICT r3 item 2).
+
+The FUSED_RECEIVE / FUSED_GOSSIP / FOLDED conf keys default to ``-1``
+(auto).  Auto resolves ON only when every link in the evidence chain
+holds; otherwise it quietly stays off (auto never raises — explicit
+``1`` keeps today's loud structural errors):
+
+1. this process resolved its platform to a real TPU
+   (``DM_RESOLVED_PLATFORM`` — set by runtime.platform.resolve_platform,
+   which the CLI, bench, and profilers all call first);
+2. the config structurally supports the path (same predicates
+   tpu_hash.make_config enforces for explicit opt-in);
+3. the REAL chip has a banked bit-exactness verdict for the exact
+   kernel family: ``scripts/tpu_correctness.py`` runs the full scan
+   under each variant on hardware and bit-compares final states; the
+   ladder daemon banks its record into ``artifacts/TPU_PROFILE.json``.
+   Interpret-mode equality on CPU does NOT clear a family — round 4
+   opened with the gossip kernels failing to even lower on real Mosaic
+   after a fully green CPU suite.
+
+The family keys mirror tpu_correctness.py's ``mismatched_elements``:
+``fused_receive``, ``fused_gossip``, ``fused_both``, ``folded_s{S}``,
+``folded_fused_s{S}``.  A missing record, a non-tpu record, or a family
+absent from the record (e.g. a fold factor the correctness N could not
+fold) all read as NOT cleared — fail closed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PROFILE_ENV = "DM_TPU_PROFILE"          # test override
+DEFAULT_PROFILE = os.path.join(_ROOT, "artifacts", "TPU_PROFILE.json")
+
+
+def on_tpu() -> bool:
+    """Has this process resolved to a real TPU?  Cheap: reads the cache
+    env var only — never probes (make_config runs on every conf load)."""
+    return os.environ.get("DM_RESOLVED_PLATFORM") == "tpu"
+
+
+def banked_correctness() -> dict | None:
+    """Latest banked real-TPU correctness record, or None."""
+    path = os.environ.get(PROFILE_ENV, DEFAULT_PROFILE)
+    try:
+        with open(path) as fh:
+            rows = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    recs = [r for r in rows
+            if r.get("check") == "fused_vs_jnp_same_platform"
+            and r.get("platform") == "tpu"]
+    return recs[-1] if recs else None
+
+
+def families_clean(rec: dict | None, *families: str) -> bool:
+    """True iff ``rec`` (a banked real-TPU correctness record) covers
+    EVERY named family with zero mismatched elements.  A record without
+    per-family detail clears nothing — a bare ``ok: true`` cannot prove
+    a family it never names (fail closed)."""
+    if rec is None:
+        return False
+    mism = rec.get("mismatched_elements")
+    if not isinstance(mism, dict):
+        return False
+    for fam in families:
+        if fam not in mism:          # family not checked: fail closed
+            return False
+        if any(mism[fam].values() if isinstance(mism[fam], dict)
+               else [mism[fam]]):
+            return False
+    return True
+
+
+def hw_cleared(*families: str) -> bool:
+    """Convenience single-call form of :func:`families_clean` (re-reads
+    the profile; batch callers should load once via banked_correctness)."""
+    return families_clean(banked_correctness(), *families)

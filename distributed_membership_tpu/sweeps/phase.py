@@ -51,7 +51,13 @@ class SweepSpec:
     name: str = "phase_sweep"   # artifact file stem
 
     def to_params(self) -> Params:
-        # fanout here is only the static bound; cells pass theirs dynamically.
+        # fanout here is only the static bound; cells pass theirs
+        # dynamically.  The fast-path knobs are PINNED off: the sweep
+        # runs make_step(dynamic_knobs=True) with drops injected as
+        # traced values, which the FUSED_GOSSIP kernel and the folded
+        # layout cannot take — a drop-free base config would otherwise
+        # let the -1 auto default resolve them on under a banked TPU
+        # record and trip make_step's dynamic-knobs guard.
         return Params.from_text(
             f"MAX_NNB: {self.n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             f"MSG_DROP_PROB: 0\nVIEW_SIZE: {self.view_size}\n"
@@ -60,6 +66,7 @@ class SweepSpec:
             f"TREMOVE: {self.tremove}\nTOTAL_TIME: {self.ticks}\n"
             f"FAIL_TIME: {self.fail_time}\nJOIN_MODE: warm\n"
             f"EVENT_MODE: agg\nEXCHANGE: {self.exchange}\n"
+            f"FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
             f"BACKEND: tpu_hash\n")
 
     @staticmethod

@@ -1,0 +1,142 @@
+"""Auto-resolution of FUSED_RECEIVE / FUSED_GOSSIP / FOLDED (= -1).
+
+The fast paths default to 'auto': on only when the process resolved to a
+real TPU AND the banked hardware correctness record
+(artifacts/TPU_PROFILE.json — scripts/tpu_correctness.py via the ladder)
+has proven the exact kernel family bit-exact on chip, AND the config
+structurally supports the path.  Fail closed everywhere else
+(runtime/fusegate.py; resolution in tpu_hash.make_config).
+"""
+
+import json
+
+import pytest
+
+from distributed_membership_tpu.backends.tpu_hash import make_config
+from distributed_membership_tpu.config import Params
+
+CLEAN = {"fused_receive": {}, "fused_gossip": {}, "fused_both": {},
+         "folded_s16": {}, "folded_fused_s16": {}, "folded_s64": {},
+         "folded_fused_s64": {}}
+
+
+def _bank(tmp_path, monkeypatch, mismatched, platform="tpu"):
+    path = tmp_path / "profile.json"
+    path.write_text(json.dumps([
+        {"rung": "65k_s64", "platform": "tpu"},   # timing rows are ignored
+        {"check": "fused_vs_jnp_same_platform", "platform": platform,
+         "ok": not any(any(v.values()) if isinstance(v, dict) else v
+                       for v in mismatched.values()),
+         "mismatched_elements": mismatched},
+    ]))
+    monkeypatch.setenv("DM_TPU_PROFILE", str(path))
+
+
+def _params(s=128, extra=""):
+    return Params.from_text(
+        f"MAX_NNB: 2048\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {max(s // 4, 2)}\n"
+        f"PROBES: {max(s // 8, 2)}\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+        f"TOTAL_TIME: 60\nFAIL_TIME: 30\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+        f"EXCHANGE: ring\n{extra}BACKEND: tpu_hash\n")
+
+
+@pytest.mark.quick
+def test_auto_off_without_tpu(tmp_path, monkeypatch):
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.delenv("DM_RESOLVED_PLATFORM", raising=False)
+    cfg = make_config(_params(), collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "cpu")
+    cfg = make_config(_params(), collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+
+
+@pytest.mark.quick
+def test_auto_on_with_banked_clean_record(tmp_path, monkeypatch):
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    cfg = make_config(_params(s=128), collect_events=False)
+    assert cfg.fused_receive and cfg.fused_gossip
+    assert not cfg.folded                      # S=128 does not fold
+    cfg16 = make_config(_params(s=16), collect_events=False)
+    assert cfg16.folded
+    assert cfg16.fused_receive and cfg16.fused_gossip
+
+
+@pytest.mark.quick
+def test_auto_respects_per_family_verdicts(tmp_path, monkeypatch):
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    dirty = dict(CLEAN)
+    dirty["fused_gossip"] = {"view": 7}
+    _bank(tmp_path, monkeypatch, dirty)
+    cfg = make_config(_params(s=128), collect_events=False)
+    assert cfg.fused_receive and not cfg.fused_gossip
+    # A family missing from the record fails closed (e.g. the fold
+    # factor the correctness N could not fold).
+    partial = {k: v for k, v in CLEAN.items() if k != "folded_s16"}
+    _bank(tmp_path, monkeypatch, partial)
+    cfg16 = make_config(_params(s=16), collect_events=False)
+    assert not cfg16.folded
+
+
+@pytest.mark.quick
+def test_auto_off_without_any_record(tmp_path, monkeypatch):
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    monkeypatch.setenv("DM_TPU_PROFILE", str(tmp_path / "missing.json"))
+    cfg = make_config(_params(), collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+    # A bare ok:true with no per-family detail clears NOTHING — it
+    # cannot prove a family it never names.
+    path = tmp_path / "bare.json"
+    path.write_text(json.dumps([
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": True}]))
+    monkeypatch.setenv("DM_TPU_PROFILE", str(path))
+    cfg = make_config(_params(), collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+
+
+@pytest.mark.quick
+def test_auto_off_on_sharded_backend(tmp_path, monkeypatch):
+    """The banked evidence proves the single-chip tpu_hash lowering only;
+    the sharded backend's shard_map elaboration is different Mosaic, so
+    its auto knobs stay off until a sharded correctness arm exists."""
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    p = _params()
+    p.BACKEND = "tpu_hash_sharded"
+    cfg = make_config(p, collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+
+
+@pytest.mark.quick
+def test_explicit_knobs_override_auto(tmp_path, monkeypatch):
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    off = _params(extra="FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n")
+    cfg = make_config(off, collect_events=False)
+    assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+    # Explicit on works with no TPU and no record (interpret fallback,
+    # structural errors stay loud) — unchanged behavior.
+    monkeypatch.delenv("DM_RESOLVED_PLATFORM", raising=False)
+    monkeypatch.setenv("DM_TPU_PROFILE", str(tmp_path / "missing.json"))
+    on = _params(extra="FUSED_RECEIVE: 1\nFUSED_GOSSIP: 1\n")
+    cfg = make_config(on, collect_events=False)
+    assert cfg.fused_receive and cfg.fused_gossip
+    bad = _params()
+    bad.FUSED_RECEIVE = 2
+    with pytest.raises(ValueError, match="FUSED_RECEIVE"):
+        bad.validate()
+
+
+@pytest.mark.quick
+def test_auto_gossip_stays_off_under_drops(tmp_path, monkeypatch):
+    """The natural-layout gossip kernel cannot replicate per-shift drop
+    masks; auto must respect that structurally, not raise."""
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    p = _params(extra=("DROP_MSG: 1\nMSG_DROP_PROB: 0.05\n"
+                       "DROP_START: 10\nDROP_STOP: 50\n"))
+    cfg = make_config(p, collect_events=False)
+    assert cfg.fused_receive and not cfg.fused_gossip
