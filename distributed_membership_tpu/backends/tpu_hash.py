@@ -878,15 +878,21 @@ def make_config(params: Params, collect_events: bool = True,
             folded_supported)
         from distributed_membership_tpu.runtime.fusegate import (
             banked_correctness, families_clean, on_tpu)
-        # Auto enables only what the banked evidence actually proves:
-        # scripts/tpu_correctness.py runs BACKEND tpu_hash single-chip,
-        # so the sharded backend's shard_map lowering (different Mosaic
-        # elaboration over local rows) is NOT covered — its auto knobs
-        # stay off until a sharded correctness arm exists.  Explicit 1
-        # remains available there (validated per-shard, loudly).
-        eligible = on_tpu() and params.BACKEND == "tpu_hash"
+        # Auto enables only what the banked evidence actually proves.
+        # scripts/tpu_correctness.py runs two arms on the chip: BACKEND
+        # tpu_hash single-chip (bare families) and the same scans inside
+        # shard_map over a one-device mesh ('sharded_' families — the
+        # kernels' shard_map elaboration is different Mosaic; the
+        # cross-chip ppermutes it cannot exercise are standard XLA
+        # collectives).  Each backend's auto knobs unlock only on ITS
+        # families; other backends never auto-enable.  Explicit 1 stays
+        # available everywhere (validated loudly).
+        pre = {"tpu_hash": "", "tpu_hash_sharded": "sharded_"}.get(
+            params.BACKEND)
+        eligible = on_tpu() and pre is not None
         rec = banked_correctness() if eligible else None
-        cleared = lambda *fams: families_clean(rec, *fams)  # noqa: E731
+        cleared = lambda *fams: families_clean(  # noqa: E731
+            rec, *(pre + f for f in fams))
         if fold_knob == -1:
             fold_knob = int(
                 eligible and exchange == "ring"

@@ -1123,42 +1123,76 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     n_local = n // d
     fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
     cfg = make_config(params, collect_events, fail_ids=fail_ids)
+
+    # Per-shard structural re-validation: make_config checked the GLOBAL
+    # shapes; the folded planes / kernel row blocks cover the LOCAL rows
+    # here.  A violated path that the user PINNED on (knob 1) raises
+    # loudly; one the fusegate auto-enabled (knob -1, resolved against
+    # global shapes only) silently downgrades to the jnp path — auto
+    # never raises.
+    def _downgrade_or_raise(knob: int, msg: str, **off):
+        nonlocal cfg
+        if knob == -1:
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, **off)
+        else:
+            raise ValueError(msg)
+
     if cfg.folded:
         from distributed_membership_tpu.backends.tpu_hash_folded import (
             folded_supported)
-        # make_config validated against global N; the folded planes are
-        # the per-shard LOCAL rows here.
         if not folded_supported(n_local, cfg.s, cfg.probes):
-            raise ValueError(
+            _downgrade_or_raise(
+                params.FOLDED,
                 f"FOLDED on tpu_hash_sharded needs the per-shard row "
                 f"count to fold (L={n_local}, S={cfg.s}, P={cfg.probes}: "
-                "L must be a multiple of 128/S and 128/P)")
+                "L must be a multiple of 128/S and 128/P)",
+                folded=False,
+                # The folded-fused twins ship as a pair with the layout;
+                # auto-resolved kernels must not survive its downgrade
+                # onto the natural S<128 planes they cannot tile.
+                fused_receive=(cfg.fused_receive
+                               and params.FUSED_RECEIVE != -1),
+                fused_gossip=(cfg.fused_gossip
+                              and params.FUSED_GOSSIP != -1))
     if cfg.folded and (cfg.fused_gossip or cfg.fused_receive):
-        # Folded twins of the fused kernels run over the LOCAL folded
-        # planes [L*S/128, 128]; only the row-block tiling minimum
-        # applies (make_config checked the global shape).
+        # Only the row-block tiling minimum applies on the local planes.
         if (n_local * cfg.s) // 128 < 8:
-            raise ValueError(
+            pinned = ((cfg.fused_receive and params.FUSED_RECEIVE == 1)
+                      or (cfg.fused_gossip and params.FUSED_GOSSIP == 1))
+            _downgrade_or_raise(
+                1 if pinned else -1,
                 f"FOLDED FUSED_* on tpu_hash_sharded needs at least 8 "
                 f"local plane rows (L*S/128 >= 8; got L={n_local}, "
-                f"S={cfg.s})")
-    elif cfg.fused_gossip and n_local < 8:
-        # make_config validated against global N; the stacked kernel's
-        # row blocks cover the LOCAL rows and need the 8-sublane tiling
-        # minimum (same rule as fused_receive below).
-        raise ValueError(
-            f"FUSED_GOSSIP on tpu_hash_sharded needs at least 8 rows per "
-            f"shard (got L={n_local})")
-    elif cfg.fused_receive:
-        # make_config validated against global N; the kernel runs over the
-        # LOCAL rows here.
-        from distributed_membership_tpu.ops.fused_receive import (
-            fused_supported)
-        if not fused_supported(n_local, cfg.s):
-            raise ValueError(
-                f"FUSED_RECEIVE on tpu_hash_sharded needs the per-shard row "
-                f"count to support the kernel tiling (got L={n_local}, "
-                f"S={cfg.s}; need S % 128 == 0 and L >= 8)")
+                f"S={cfg.s})",
+                fused_receive=False, fused_gossip=False)
+    elif not cfg.folded:
+        # Full natural-shape re-check for BOTH kernels: a pinned kernel
+        # can arrive here having passed only make_config's FOLDED-branch
+        # validation (8 plane rows) and then lost the folded layout to
+        # the per-shard downgrade above — S < 128 or a droppy config
+        # must not reach the natural stacked kernel.
+        if cfg.fused_gossip and (n_local < 8 or cfg.s % 128 != 0
+                                 or cfg.drop_prob > 0):
+            _downgrade_or_raise(
+                params.FUSED_GOSSIP,
+                f"FUSED_GOSSIP on tpu_hash_sharded needs S % 128 == 0, "
+                f"a drop-free config, and at least 8 rows per shard "
+                f"(got L={n_local}, S={cfg.s}, drop={cfg.drop_prob}); "
+                "for S < 128 it requires the FOLDED layout, which the "
+                "per-shard row count rejected",
+                fused_gossip=False)
+        if cfg.fused_receive:
+            from distributed_membership_tpu.ops.fused_receive import (
+                fused_supported)
+            if not fused_supported(n_local, cfg.s):
+                _downgrade_or_raise(
+                    params.FUSED_RECEIVE,
+                    f"FUSED_RECEIVE on tpu_hash_sharded needs the "
+                    f"per-shard row count to support the kernel tiling "
+                    f"(got L={n_local}, S={cfg.s}; need S % 128 == 0 "
+                    f"and L >= 8)",
+                    fused_receive=False)
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"

@@ -25,12 +25,22 @@ sys.path.insert(0, REPO)
 
 def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
              n: int = 8192, s: int = 128, ticks: int = 60,
-             folded: bool = False):
+             folded: bool = False, sharded: bool = False):
+    """One full scan; returns the flattened final-state pytree.
+
+    ``sharded`` runs the SAME config on BACKEND tpu_hash_sharded over a
+    ONE-device mesh: one chip cannot exercise cross-chip ppermutes
+    (standard XLA collectives anyway), but it does exercise the part
+    with real Mosaic risk — the Pallas kernels' elaboration INSIDE
+    shard_map over local rows, a different lowering than the single-chip
+    path.  The sharded checks gate the sharded backend's auto knobs
+    (runtime/fusegate.py 'sharded_*' families).  One config template
+    serves both arms so they can never drift apart.
+    """
     import random as _pyrandom
 
     import numpy as np
 
-    from distributed_membership_tpu.backends.tpu_hash import run_scan
     from distributed_membership_tpu.config import Params
     from distributed_membership_tpu.runtime.failures import make_plan
 
@@ -38,6 +48,7 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"DROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
         f"DROP_START: 10\nDROP_STOP: {ticks - 10}\n" if drops else
         "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    backend = "tpu_hash_sharded" if sharded else "tpu_hash"
     params = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 4}\nPROBES: {s // 8}\n"
@@ -45,9 +56,21 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
-        f"BACKEND: tpu_hash\n")
+        f"BACKEND: {backend}\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
-    final_state, _ = run_scan(params, plan, seed=0, collect_events=False)
+    if sharded:
+        from distributed_membership_tpu.backends.tpu_hash_sharded import (
+            run_scan_sharded)
+        from distributed_membership_tpu.parallel.mesh import make_mesh
+
+        final_state, _ = run_scan_sharded(params, plan, seed=0,
+                                          mesh=make_mesh(1),
+                                          collect_events=False)
+    else:
+        from distributed_membership_tpu.backends.tpu_hash import run_scan
+
+        final_state, _ = run_scan(params, plan, seed=0,
+                                  collect_events=False)
     # Compare the ENTIRE final state pytree (view, timestamps, mailboxes,
     # scalars, and whichever aggregate struct the config selected).
     import jax
@@ -55,6 +78,10 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
     leaves, treedef = jax.tree_util.tree_flatten_with_path(final_state)
     return {jax.tree_util.keystr(path): np.asarray(leaf)
             for path, leaf in leaves}
+
+
+def run_once_s(*a, **kw):
+    return run_once(*a, **kw, sharded=True)
 
 
 def main() -> int:
@@ -120,6 +147,35 @@ def main() -> int:
         checks[f"folded_fused_s{s_f}"] = {
             k: int((fold_f[k].reshape(-1) != ffus_f[k].reshape(-1)).sum())
             for k in fold_f}
+
+    # Sharded arm (run_once's ``sharded`` flag): the same scans inside
+    # shard_map on one chip, gating the sharded backend's auto knobs.
+    sh_base_d = run_once_s(False, False, True, n=args.n, ticks=args.ticks)
+    sh_recv_d = run_once_s(True, False, True, n=args.n, ticks=args.ticks)
+    checks["sharded_fused_receive"] = diff(sh_base_d, sh_recv_d)
+    sh_base = run_once_s(False, False, False, n=args.n, ticks=args.ticks)
+    sh_goss = run_once_s(False, True, False, n=args.n, ticks=args.ticks)
+    sh_both = run_once_s(True, True, False, n=args.n, ticks=args.ticks)
+    checks["sharded_fused_gossip"] = diff(sh_base, sh_goss)
+    checks["sharded_fused_both"] = diff(sh_base, sh_both)
+    for s_f in (16, 64):
+        probes_f = s_f // 8
+        if not folded_supported(args.n, s_f, probes_f):
+            print(f"note: sharded_folded_s{s_f} skipped — n={args.n} "
+                  f"does not fold at S={s_f}", flush=True)
+            continue
+        shb_f = run_once_s(False, False, True, n=args.n, s=s_f,
+                                 ticks=args.ticks)
+        shf_f = run_once_s(False, False, True, n=args.n, s=s_f,
+                                 ticks=args.ticks, folded=True)
+        checks[f"sharded_folded_s{s_f}"] = {
+            k: int((shb_f[k].reshape(-1) != shf_f[k].reshape(-1)).sum())
+            for k in shb_f}
+        shff_f = run_once_s(True, True, True, n=args.n, s=s_f,
+                                  ticks=args.ticks, folded=True)
+        checks[f"sharded_folded_fused_s{s_f}"] = {
+            k: int((shf_f[k].reshape(-1) != shff_f[k].reshape(-1)).sum())
+            for k in shf_f}
 
     mism = {name: {k: v for k, v in d.items() if v}
             for name, d in checks.items()}

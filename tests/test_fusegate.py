@@ -97,17 +97,104 @@ def test_auto_off_without_any_record(tmp_path, monkeypatch):
     assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
 
 
+SHARDED_CLEAN = {**CLEAN,
+                 "sharded_fused_receive": {}, "sharded_fused_gossip": {},
+                 "sharded_fused_both": {}, "sharded_folded_s16": {},
+                 "sharded_folded_fused_s16": {}}
+
+
 @pytest.mark.quick
-def test_auto_off_on_sharded_backend(tmp_path, monkeypatch):
-    """The banked evidence proves the single-chip tpu_hash lowering only;
-    the sharded backend's shard_map elaboration is different Mosaic, so
-    its auto knobs stay off until a sharded correctness arm exists."""
-    _bank(tmp_path, monkeypatch, CLEAN)
+def test_sharded_auto_needs_sharded_families(tmp_path, monkeypatch):
+    """The single-chip families prove the tpu_hash lowering only; the
+    sharded backend's auto knobs unlock on the 'sharded_*' families
+    (the kernels' shard_map elaboration — tpu_correctness's second arm)
+    and stay off when the record has only the bare ones."""
     monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    _bank(tmp_path, monkeypatch, CLEAN)          # no sharded families
     p = _params()
     p.BACKEND = "tpu_hash_sharded"
     cfg = make_config(p, collect_events=False)
     assert not cfg.fused_receive and not cfg.fused_gossip and not cfg.folded
+    _bank(tmp_path, monkeypatch, SHARDED_CLEAN)
+    cfg = make_config(p, collect_events=False)
+    assert cfg.fused_receive and cfg.fused_gossip
+    p16 = _params(s=16)
+    p16.BACKEND = "tpu_hash_sharded"
+    cfg16 = make_config(p16, collect_events=False)
+    assert cfg16.folded and cfg16.fused_receive and cfg16.fused_gossip
+
+
+@pytest.mark.quick
+def test_sharded_auto_downgrades_on_local_shapes(tmp_path, monkeypatch):
+    """Auto-enabled kernels that the PER-SHARD shapes cannot tile are
+    silently downgraded by run_scan_sharded (auto never raises); the
+    same violation with a pinned knob still raises."""
+    import random as _pyrandom
+
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        run_scan_sharded)
+    from distributed_membership_tpu.parallel.mesh import make_mesh
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    _bank(tmp_path, monkeypatch, SHARDED_CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    # S=128 with N=32 on the 8-device mesh: the kernels' GLOBAL shape
+    # passes (fused_supported(32, 128)) so auto turns them on, but the
+    # per-shard L=4 < 8 cannot tile the row blocks.
+    p = _params()          # S=128, auto knobs
+    p.BACKEND = "tpu_hash_sharded"
+    p.EN_GPSZ = 32
+    p.TOTAL_TIME = 40
+    p.FAIL_TIME = 20
+    plan = make_plan(p, _pyrandom.Random("app:0"))
+    # Auto: runs clean on the jnp path (no raise).
+    run_scan_sharded(p, plan, seed=0, mesh=make_mesh(8),
+                     collect_events=False)
+    # Pinned: the same violation raises loudly.
+    p.FUSED_RECEIVE = 1
+    p.FUSED_GOSSIP = 0
+    p.FOLDED = 0
+    with pytest.raises(ValueError, match="FUSED_RECEIVE on tpu_hash_sharded"):
+        run_scan_sharded(p, plan, seed=0, mesh=make_mesh(8),
+                         collect_events=False)
+
+
+@pytest.mark.quick
+def test_folded_downgrade_never_strands_pinned_gossip(tmp_path, monkeypatch):
+    """Auto-FOLDED can downgrade per-shard (global N folds, L does not);
+    a PINNED natural kernel must then be re-validated against the
+    natural shapes — S=16 cannot tile the natural gossip kernel, so
+    pinning it raises rather than silently miscompiling; fully-auto
+    kernels downgrade with the layout."""
+    import random as _pyrandom
+
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        run_scan_sharded)
+    from distributed_membership_tpu.parallel.mesh import make_mesh
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    _bank(tmp_path, monkeypatch, SHARDED_CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    # N=1664, D=8: global fold needs N % 64 == 0 (ok: 1664 = 26*64);
+    # per-shard L=208 needs L % 64 == 0 (208 = 3*64 + 16 — fails).
+    p = _params(s=16)
+    p.BACKEND = "tpu_hash_sharded"
+    p.EN_GPSZ = 1664
+    p.TOTAL_TIME = 40
+    p.FAIL_TIME = 20
+    plan = make_plan(p, _pyrandom.Random("app:0"))
+    # Fully auto: folded auto-enables globally, downgrades per-shard,
+    # and takes its auto kernels down with it — clean jnp run.
+    run_scan_sharded(p, plan, seed=0, mesh=make_mesh(8),
+                     collect_events=False)
+    # Pinned gossip kernel: survives the layout downgrade but S=16
+    # cannot tile the NATURAL stacked kernel — loud error, not Mosaic
+    # garbage.
+    p.FUSED_GOSSIP = 1
+    plan = make_plan(p, _pyrandom.Random("app:0"))
+    with pytest.raises(ValueError, match="FUSED_GOSSIP on tpu_hash_sharded"):
+        run_scan_sharded(p, plan, seed=0, mesh=make_mesh(8),
+                         collect_events=False)
 
 
 @pytest.mark.quick
