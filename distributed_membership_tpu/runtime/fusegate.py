@@ -43,17 +43,36 @@ def on_tpu() -> bool:
 
 
 def banked_correctness() -> dict | None:
-    """Latest banked real-TPU correctness record, or None."""
+    """The banked real-TPU correctness verdict, or None.
+
+    The ladder banks the correctness families in up to three per-arm
+    records (single-chip / folded / sharded — scripts/tpu_ladder.py
+    CORRECTNESS_ARMS); they are merged here family-keyed, later records
+    overriding earlier ones, so a re-run that fixes one family updates
+    just that family's verdict."""
     path = os.environ.get(PROFILE_ENV, DEFAULT_PROFILE)
     try:
         with open(path) as fh:
             rows = json.load(fh)
     except (OSError, json.JSONDecodeError):
         return None
-    recs = [r for r in rows
-            if r.get("check") == "fused_vs_jnp_same_platform"
-            and r.get("platform") == "tpu"]
-    return recs[-1] if recs else None
+    mism: dict = {}
+    found = False
+    for r in rows:
+        if (r.get("check") != "fused_vs_jnp_same_platform"
+                or r.get("platform") != "tpu"):
+            continue
+        fams = r.get("mismatched_elements")
+        if not isinstance(fams, dict):
+            continue          # detail-free records prove nothing
+        found = True
+        mism.update(fams)
+    if not found:
+        return None
+    return {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+            "ok": not any(any(v.values()) if isinstance(v, dict) else v
+                          for v in mism.values()),
+            "mismatched_elements": mism}
 
 
 def families_clean(rec: dict | None, *families: str) -> bool:

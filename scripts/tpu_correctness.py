@@ -91,6 +91,12 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=8192)
     ap.add_argument("--ticks", type=int, default=60)
     ap.add_argument("--platform", default=None)
+    # The flaky relay can hang mid-run and an aborted run banks NOTHING,
+    # so the ladder runs the three arms as separate rungs — a flake
+    # costs one arm, not the whole evidence set (the fusegate merges the
+    # banked per-arm records by family).
+    ap.add_argument("--arm", default="all",
+                    choices=("all", "single", "folded", "sharded"))
     args = ap.parse_args()
 
     from distributed_membership_tpu.runtime.platform import resolve_platform
@@ -105,17 +111,19 @@ def main() -> int:
         return {k: int((a[k] != b[k]).sum()) for k in a}
 
     checks = {}
-    # Receive kernel under the droppy config (its hardest regime).
-    base_d = run_once(False, False, True, n=args.n, ticks=args.ticks)
-    recv_d = run_once(True, False, True, n=args.n, ticks=args.ticks)
-    checks["fused_receive"] = diff(base_d, recv_d)
-    # Gossip kernel (drop-free by contract), alone and with the receive
-    # kernel — the composition is what FUSED defaults would ship.
-    base = run_once(False, False, False, n=args.n, ticks=args.ticks)
-    goss = run_once(False, True, False, n=args.n, ticks=args.ticks)
-    both = run_once(True, True, False, n=args.n, ticks=args.ticks)
-    checks["fused_gossip"] = diff(base, goss)
-    checks["fused_both"] = diff(base, both)
+    arm = args.arm
+    if arm in ("all", "single"):
+        # Receive kernel under the droppy config (its hardest regime).
+        base_d = run_once(False, False, True, n=args.n, ticks=args.ticks)
+        recv_d = run_once(True, False, True, n=args.n, ticks=args.ticks)
+        checks["fused_receive"] = diff(base_d, recv_d)
+        # Gossip kernel (drop-free by contract), alone and with the
+        # receive kernel — the composition FUSED defaults would ship.
+        base = run_once(False, False, False, n=args.n, ticks=args.ticks)
+        goss = run_once(False, True, False, n=args.n, ticks=args.ticks)
+        both = run_once(True, True, False, n=args.n, ticks=args.ticks)
+        checks["fused_gossip"] = diff(base, goss)
+        checks["fused_both"] = diff(base, both)
     # Folded layout vs the natural layout at each fold factor the ladder
     # times (S=16 -> F=8, S=64 -> F=2; the folded planes reshape to the
     # natural ones for the comparison).  These are the on-chip gates for
@@ -125,7 +133,7 @@ def main() -> int:
     from distributed_membership_tpu.backends.tpu_hash_folded import (
         folded_supported)
 
-    for s_f in (16, 64):
+    for s_f in (16, 64) if arm in ("all", "folded") else ():
         probes_f = s_f // 8
         if not folded_supported(args.n, s_f, probes_f):
             print(f"note: folded_s{s_f} skipped — n={args.n} does not "
@@ -150,15 +158,21 @@ def main() -> int:
 
     # Sharded arm (run_once's ``sharded`` flag): the same scans inside
     # shard_map on one chip, gating the sharded backend's auto knobs.
-    sh_base_d = run_once_s(False, False, True, n=args.n, ticks=args.ticks)
-    sh_recv_d = run_once_s(True, False, True, n=args.n, ticks=args.ticks)
-    checks["sharded_fused_receive"] = diff(sh_base_d, sh_recv_d)
-    sh_base = run_once_s(False, False, False, n=args.n, ticks=args.ticks)
-    sh_goss = run_once_s(False, True, False, n=args.n, ticks=args.ticks)
-    sh_both = run_once_s(True, True, False, n=args.n, ticks=args.ticks)
-    checks["sharded_fused_gossip"] = diff(sh_base, sh_goss)
-    checks["sharded_fused_both"] = diff(sh_base, sh_both)
-    for s_f in (16, 64):
+    if arm in ("all", "sharded"):
+        sh_base_d = run_once_s(False, False, True, n=args.n,
+                               ticks=args.ticks)
+        sh_recv_d = run_once_s(True, False, True, n=args.n,
+                               ticks=args.ticks)
+        checks["sharded_fused_receive"] = diff(sh_base_d, sh_recv_d)
+        sh_base = run_once_s(False, False, False, n=args.n,
+                             ticks=args.ticks)
+        sh_goss = run_once_s(False, True, False, n=args.n,
+                             ticks=args.ticks)
+        sh_both = run_once_s(True, True, False, n=args.n,
+                             ticks=args.ticks)
+        checks["sharded_fused_gossip"] = diff(sh_base, sh_goss)
+        checks["sharded_fused_both"] = diff(sh_base, sh_both)
+    for s_f in (16, 64) if arm in ("all", "sharded") else ():
         probes_f = s_f // 8
         if not folded_supported(args.n, s_f, probes_f):
             print(f"note: sharded_folded_s{s_f} skipped — n={args.n} "

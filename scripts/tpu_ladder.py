@@ -41,17 +41,29 @@ OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
 # delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128)
 # | 'folded_fboth' (folded layout + BOTH folded-fused Pallas kernels,
 # ops/fused_folded — the north-star combination, PERF.md roofline).
-# The special first rung runs scripts/tpu_correctness.py (bit-equality
-# of both Pallas kernels AND the folded layout vs the baseline on the
-# real chip — 7 scans) instead of a timing point; a failing family
-# gates only its own timing rungs (Pallas vs folded).
-CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 1800)
+# The special correctness rungs run scripts/tpu_correctness.py (full
+# scans on the chip, final states bit-compared) instead of a timing
+# point; a failing family gates only its own timing rungs.  They are
+# SPLIT into three arms — single-chip kernels, folded layout, sharded
+# shard_map — because an aborted run banks nothing and the relay can
+# hang at any scan: one flake now costs one arm, not the evidence set.
+# The fusegate and the gating below merge the banked per-arm records by
+# family.
+CORRECTNESS_ARMS = {
+    "fused_correctness": "single",      # fused_receive/gossip/both
+    "folded_correctness": "folded",     # folded_s* + folded_fused_s*
+    "sharded_correctness": "sharded",   # sharded_* twins of the above
+}
+CORRECTNESS_RUNG = ("fused_correctness", 8192, 128, 60, "off", 900)
+FOLDED_CORR_RUNG = ("folded_correctness", 8192, 128, 60, "off", 900)
+SHARDED_CORR_RUNG = ("sharded_correctness", 8192, 128, 60, "off", 1800)
 # Cheap hardware probe of the S<128 lane-padding premise (PERF.md) —
 # memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
 # timing; decides whether the folded layout is the next step.
 LAYOUT_RUNG = ("layout_probe", 1 << 20, 16, 0, "off", 420)
 LADDER = [
     CORRECTNESS_RUNG,
+    FOLDED_CORR_RUNG,
     LAYOUT_RUNG,
     ("65k_s64",          1 << 16,  64, 150, "off",    240),
     ("65k_s128",         1 << 16, 128, 100, "off",    300),
@@ -70,6 +82,9 @@ LADDER = [
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
     ("1M_s128",          1 << 20, 128,  40, "off",    900),
     ("1M_s128_fboth",    1 << 20, 128,  40, "both",   900),
+    # Last: gates no timing rungs (it unlocks the sharded backend's auto
+    # knobs at runtime), so all perf evidence lands first.
+    SHARDED_CORR_RUNG,
 ]
 
 
@@ -107,10 +122,11 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
              timeout: float) -> dict | None:
     env = dict(os.environ)
     env["DM_RESOLVED_PLATFORM"] = "tpu"   # probe said yes; don't re-probe
-    if name == CORRECTNESS_RUNG[0]:
+    if name in CORRECTNESS_ARMS:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_correctness.py"),
-               "--n", str(n), "--ticks", str(ticks)]
+               "--n", str(n), "--ticks", str(ticks),
+               "--arm", CORRECTNESS_ARMS[name]]
     elif name == LAYOUT_RUNG[0]:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_layout_probe.py"),
@@ -134,7 +150,7 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
               flush=True)
         return None
     if r.returncode != 0:
-        if name == CORRECTNESS_RUNG[0]:
+        if name in CORRECTNESS_ARMS:
             # A deterministic fused-vs-jnp mismatch is EVIDENCE, not a relay
             # flake: tpu_correctness.py exits 1 with the mismatch JSON on
             # stdout.  Record it (so --loop doesn't retry forever) and let
@@ -218,13 +234,52 @@ def _corr_covers_ladder(rec) -> bool:
         for k in rec.get("mismatched_elements", {}))
 
 
+# The family set each arm is RESPONSIBLE for: a record that reports
+# ok=false with no per-family detail (a crash-truncated verdict) is
+# read as all of ITS OWN families dirty — fail closed for what it
+# covered, without smearing onto families another arm re-checks.
+ARM_FAMILIES = {
+    "fused_correctness": ("fused_receive", "fused_gossip", "fused_both"),
+    "folded_correctness": ("folded_s16", "folded_fused_s16",
+                           "folded_s64", "folded_fused_s64"),
+    "sharded_correctness": ("sharded_fused_receive",
+                            "sharded_fused_gossip", "sharded_fused_both",
+                            "sharded_folded_s16",
+                            "sharded_folded_fused_s16",
+                            "sharded_folded_s64",
+                            "sharded_folded_fused_s64"),
+}
+
+
+def _merged_corr(done: dict):
+    """Merge the banked per-arm correctness records into one verdict
+    (family-keyed union; each family appears in exactly one arm).  The
+    merged ``ok`` derives from the merged DETAIL only — a record's own
+    stale flag must not outlive a later arm that re-checked its failing
+    family clean (it would gate everything forever with no re-arm)."""
+    mism = {}
+    found = False
+    for rung in CORRECTNESS_ARMS:
+        rec = done.get(rung)
+        if rec is None:
+            continue
+        found = True
+        detail = rec.get("mismatched_elements", {})
+        if not rec.get("ok", False) and not any(detail.values()):
+            detail = dict(detail)
+            detail.update({f: {"unknown": 1} for f in ARM_FAMILIES[rung]})
+        mism.update(detail)
+    if not found:
+        return None
+    return {"ok": not any(mism.values()), "mismatched_elements": mism}
+
+
 def _missing() -> list:
     done = load_done()
-    corr = done.get(CORRECTNESS_RUNG[0])
-    if corr is not None and not _corr_covers_ladder(corr):
-        # Re-run the correctness rung (it's first in LADDER order); the
-        # stale verdict still gates the families it DID check meanwhile.
-        del done[CORRECTNESS_RUNG[0]]
+    # A pre-split banked record under the old single rung name still
+    # merges in (its families are a superset of the 'single' arm's);
+    # arms whose families it lacks simply re-run.
+    corr = _merged_corr(done)
     return [r for r in LADDER
             if r[0] not in done
             and not (r[4] in PALLAS_MODES and r[2] % 128 != 0)
@@ -259,7 +314,7 @@ def one_pass() -> tuple[int, int]:
             break
         append(rec)
         landed += 1
-        if name == CORRECTNESS_RUNG[0] and not rec.get("ok", True):
+        if name in CORRECTNESS_ARMS and not rec.get("ok", True):
             # Gate the failing families' timing rungs off THIS pass too,
             # not just the next (_missing() only sees the failure on
             # re-read).

@@ -81,6 +81,39 @@ def test_auto_respects_per_family_verdicts(tmp_path, monkeypatch):
 
 
 @pytest.mark.quick
+def test_per_arm_records_merge_by_family(tmp_path, monkeypatch):
+    """The ladder banks correctness as up to three per-arm records; the
+    gate merges them family-keyed, so evidence accumulates arm by arm
+    (a flaky relay banks what it can) and a later re-run overrides only
+    the families it re-checked."""
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    path = tmp_path / "profile.json"
+    single = {"fused_receive": {}, "fused_gossip": {}, "fused_both": {}}
+    folded = {"folded_s16": {}, "folded_fused_s16": {}}
+    path.write_text(json.dumps([
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": True, "mismatched_elements": single},
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": True, "mismatched_elements": folded},
+    ]))
+    monkeypatch.setenv("DM_TPU_PROFILE", str(path))
+    cfg = make_config(_params(s=16), collect_events=False)   # needs BOTH arms
+    assert cfg.folded and cfg.fused_receive and cfg.fused_gossip
+    # A later record overrides only its own families.
+    path.write_text(json.dumps([
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": True, "mismatched_elements": single},
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": True, "mismatched_elements": folded},
+        {"check": "fused_vs_jnp_same_platform", "platform": "tpu",
+         "ok": False,
+         "mismatched_elements": {"folded_fused_s16": {"view": 3}}},
+    ]))
+    cfg = make_config(_params(s=16), collect_events=False)
+    assert cfg.folded and not cfg.fused_receive and not cfg.fused_gossip
+
+
+@pytest.mark.quick
 def test_auto_off_without_any_record(tmp_path, monkeypatch):
     monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
     monkeypatch.setenv("DM_TPU_PROFILE", str(tmp_path / "missing.json"))

@@ -86,13 +86,21 @@ def test_folded_correctness_failure_gates_folded_rungs_only(tmp_path):
     assert any(m in ("recv", "gossip", "both") for m in modes)
 
 
-def test_detail_free_failure_gates_all_variants(tmp_path):
+def test_detail_free_failure_gates_all_arm_variants(tmp_path):
+    """A crash-truncated verdict (ok=false, no per-family detail) reads
+    as ALL of that arm's families dirty — its timing rungs gate closed.
+    Families owned by other arms are untouched: the folded arm is still
+    armed and, by ladder order, lands its own verdict before any folded
+    timing rung would execute; folded_fboth stays closed regardless
+    until the folded_fused families are covered."""
     lad = _load_ladder(tmp_path)
     lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
                 "check": "fused_vs_jnp_same_platform", "ok": False,
                 "mismatched_elements": {}})
-    modes = [r[4] for r in lad._missing()]
-    assert all(m == "off" for m in modes), modes
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    assert not any(m in ("recv", "gossip", "both") for m in rungs.values())
+    assert lad.FOLDED_CORR_RUNG[0] in rungs
+    assert not any(m == "folded_fboth" for m in rungs.values())
 
 
 def test_folded_gate_is_fold_factor_granular(tmp_path):
@@ -109,39 +117,65 @@ def test_folded_gate_is_fold_factor_granular(tmp_path):
     assert any(r[4] in ("recv", "gossip", "both") for r in rungs.values())
 
 
-def test_stale_correctness_verdict_rearms_and_fails_closed(tmp_path):
-    """A verdict from before the folded_fused families existed (round
-    <= 3 records) must re-arm the correctness rung AND gate the
-    *_folded_fboth timing rungs closed until a covering run lands —
-    while still gating/exonerating the families it did check."""
+def test_partial_correctness_arms_fail_closed_and_accumulate(tmp_path):
+    """Correctness evidence lands as per-arm records (the relay can hang
+    at any scan, so one flake costs one arm).  A banked arm whose
+    families lack the folded_fused checks (e.g. a pre-split round-3
+    record) leaves the *_folded_fboth timing rungs gated CLOSED and the
+    folded_correctness arm armed — while gating/exonerating the
+    families it did check."""
     lad = _load_ladder(tmp_path)
     lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
                 "check": "fused_vs_jnp_same_platform", "ok": True,
                 "mismatched_elements": {"fused_receive": {},
                                         "folded_s16": {}}})
     rungs = {r[0]: r[4] for r in lad._missing()}
-    assert lad.CORRECTNESS_RUNG[0] in rungs          # re-armed
+    assert lad.CORRECTNESS_RUNG[0] not in rungs      # this arm is banked
+    assert lad.FOLDED_CORR_RUNG[0] in rungs          # the missing arm runs
     assert "1M_s16_folded_fboth" not in rungs        # fail closed
     assert any(m in ("recv", "gossip", "both") for m in rungs.values())
-    assert "1M_s16_folded" in rungs                  # old families exonerated
-    # A covering clean verdict opens the folded_fboth rungs.
-    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+    assert "1M_s16_folded" in rungs                  # banked family exonerated
+    # The folded arm landing with clean folded_fused opens folded_fboth.
+    lad.append({"rung": lad.FOLDED_CORR_RUNG[0], "platform": "tpu",
                 "check": "fused_vs_jnp_same_platform", "ok": True,
-                "mismatched_elements": {"fused_receive": {},
-                                        "folded_s16": {},
+                "mismatched_elements": {"folded_s16": {},
                                         "folded_fused_s16": {}}})
     rungs = {r[0]: r[4] for r in lad._missing()}
-    assert lad.CORRECTNESS_RUNG[0] not in rungs
+    assert lad.FOLDED_CORR_RUNG[0] not in rungs
     assert "1M_s16_folded_fboth" in rungs
-    # A covering verdict where only the folded_fused family failed
-    # gates folded_fboth but not the plain folded rungs.
+    # A folded arm where only the folded_fused family failed gates
+    # folded_fboth but not the plain folded rungs.
     lad2 = _load_ladder(tmp_path / "b")
     (tmp_path / "b").mkdir()
-    lad2.append({"rung": lad2.CORRECTNESS_RUNG[0], "platform": "tpu",
+    lad2.append({"rung": lad2.FOLDED_CORR_RUNG[0], "platform": "tpu",
                  "check": "fused_vs_jnp_same_platform", "ok": False,
-                 "mismatched_elements": {"fused_receive": {},
-                                         "folded_s16": {},
+                 "mismatched_elements": {"folded_s16": {},
                                          "folded_fused_s16": {".view": 2}}})
     rungs = {r[0]: r[4] for r in lad2._missing()}
     assert "1M_s16_folded_fboth" not in rungs
+    assert "1M_s16_folded" in rungs
+
+
+def test_later_arm_overrides_stale_failure_flag(tmp_path):
+    """Migration hazard: a pre-split record with ok=false (one folded
+    family failed) followed by a clean folded arm must yield a CLEAN
+    merged verdict — the stale record-level ok flag must not outlive
+    the re-checked families (it would gate every timing rung forever
+    with no correctness rung left to re-arm)."""
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.CORRECTNESS_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": False,
+                "mismatched_elements": {"fused_receive": {},
+                                        "fused_gossip": {},
+                                        "fused_both": {},
+                                        "folded_s16": {},
+                                        "folded_fused_s16": {"view": 9}}})
+    lad.append({"rung": lad.FOLDED_CORR_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {"folded_s16": {},
+                                        "folded_fused_s16": {}}})
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    # Every timing family re-checked clean: nothing stays gated.
+    assert "1M_s16_folded_fboth" in rungs
+    assert "65k_s128_fboth" in rungs
     assert "1M_s16_folded" in rungs
