@@ -191,22 +191,26 @@ def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
     (flat shard index), ``lax.switch`` over D static permutations since
     ``b`` is traced but replicated.
 
-    On a 1-D mesh each branch is one ``ppermute`` rotation.  On a 2-D
-    torus mesh (axes ``(outer, inner)``, flat = o*DI + i) the flat shift
-    ``b`` decomposes into per-axis ring rotations — the hops every torus
-    interconnect implements natively — instead of asking the router for
-    an arbitrary flat permutation: rotate the inner ring by ``r = b % DI``,
-    then the outer ring by ``q = b // DI`` for payloads whose inner index
-    did not wrap and ``q + 1`` for those that did.  The wrap set is
-    per-shard static after stage 1 (destination inner index < r), so
-    stage 2 is two masked outer rotations combined by that select; inner
-    wire cost is one payload, outer is two (one mostly-zero) — still
-    neighbor-hop traffic on both ICI dimensions vs. a D-way flat
-    permutation."""
+    On a 1-D mesh each branch is one ``ppermute`` rotation.  On an N-D
+    torus mesh (flat index = mixed-radix digits, major axis first) the
+    flat shift decomposes into per-axis ring rotations — the hops every
+    torus interconnect implements natively — instead of asking the
+    router for an arbitrary flat permutation.  It is mixed-radix
+    ADDITION run minor-axis-first: stage j rotates axis j by its shift
+    digit ``r_j`` plus the carry from the stage below.  The carry into
+    stage j depends only on digits MINOR to j, which rotations on axis j
+    and above preserve — so it is per-shard computable from
+    ``axis_index`` values, identical at an axis-j hop's source and
+    destination, and each stage is at most two masked rotations
+    (``r_j`` / ``r_j + 1``) combined by the carry select.  Wire cost per
+    axis: one payload on the minormost, two (one mostly-zero) above —
+    neighbor traffic on every torus dimension.  The outermost axis can
+    span DCN (multi-slice): it carries exactly one block hop per gossip
+    shift, the minimum any cross-slice delivery needs."""
     if len(axis_sizes) != len(axes):
         raise ValueError(
             f"axis_sizes {axis_sizes} must match axes {axes} — pass one "
-            "size per mesh axis (the 2-D decomposition needs both)")
+            "size per mesh axis (the per-axis decomposition needs both)")
     if len(axes) == 1:
         ax = axes[0]
 
@@ -221,33 +225,59 @@ def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
             return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
         return block_send
 
-    ao, ai = axes
-    do, di = axis_sizes
-    assert do * di == n_shards
+    assert int(np.prod(axis_sizes)) == n_shards
+
+    def _digits(i: int) -> list:
+        """Mixed-radix digits of the flat shift, minor axis first."""
+        out = []
+        for size in reversed(axis_sizes):
+            i, d = divmod(i, size)
+            out.append(d)
+        return out
 
     def block_send(tensors, b):
         def mk(i):
             if i == 0:
                 return lambda ops: ops
-            q, r = divmod(i, di)
-            perm_i = [(src, (src + r) % di) for src in range(di)]
-            perm_q = [(src, (src + q) % do) for src in range(do)]
-            perm_q1 = [(src, (src + q + 1) % do) for src in range(do)]
+            digits = _digits(i)
 
             def go(ops):
-                if r == 0:
-                    # Pure outer rotation (q > 0 since i > 0).
-                    return tuple(lax.ppermute(o, ao, perm_q) for o in ops)
-                ops = tuple(lax.ppermute(o, ai, perm_i) for o in ops)
-                carried = lax.axis_index(ai) < r
-
-                def hop(o):
-                    z = jnp.zeros_like(o)
-                    stay = jnp.where(carried, z, o)
-                    a = (lax.ppermute(stay, ao, perm_q) if q else stay)
-                    c = lax.ppermute(jnp.where(carried, o, z), ao, perm_q1)
-                    return jnp.where(carried, c, a)
-                return tuple(hop(o) for o in ops)
+                carry = None          # stage 0 has no carry-in
+                # minor → major: axes[-1] is the minormost mesh axis.
+                for j, r in enumerate(digits):
+                    ax = axes[-1 - j]
+                    size = axis_sizes[-1 - j]
+                    perm_r = [(s, (s + r) % size) for s in range(size)]
+                    perm_r1 = [(s, (s + r + 1) % size)
+                               for s in range(size)]
+                    if carry is None:
+                        if r:
+                            ops = tuple(lax.ppermute(o, ax, perm_r)
+                                        for o in ops)
+                    else:
+                        def hop(o):
+                            z = jnp.zeros_like(o)
+                            stay = jnp.where(carry, z, o)
+                            a = (lax.ppermute(stay, ax, perm_r)
+                                 if r else stay)
+                            c = lax.ppermute(jnp.where(carry, o, z),
+                                             ax, perm_r1)
+                            return jnp.where(carry, c, a)
+                        ops = tuple(hop(o) for o in ops)
+                    if j < len(digits) - 1 and not (carry is None
+                                                    and r == 0):
+                        # Carry out of stage j: the digit wrapped iff the
+                        # POST-rotation digit is below the amount added
+                        # (r, or r+1 on the carried stream).  A zero
+                        # digit with no carry-in keeps carry None — the
+                        # statically-false carry must not force the
+                        # two-stream masked hop on the axes above (e.g.
+                        # a pure slice-axis shift would otherwise send
+                        # two DCN streams where one suffices).
+                        d_new = lax.axis_index(ax)
+                        eff = r if carry is None else r + carry.astype(I32)
+                        carry = d_new < eff
+                return ops
             return go
         return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
     return block_send
@@ -1218,10 +1248,10 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
 
     if mesh is None:
         if params.MESH_SHAPE:
-            from distributed_membership_tpu.parallel.mesh import make_mesh2d
+            from distributed_membership_tpu.parallel.mesh import (
+                make_torus_mesh)
             dims = [int(x) for x in params.MESH_SHAPE.lower().split("x")]
-            mesh = (make_mesh(dims[0]) if len(dims) == 1
-                    else make_mesh2d(*dims))
+            mesh = make_torus_mesh(*dims)
         else:
             n_dev = len(jax.devices())
             d = max(x for x in range(1, n_dev + 1)

@@ -126,8 +126,10 @@ class Params:
     FOLDED: int = -1
     # Device-mesh shape for the sharded backends: '' = auto (largest
     # 1-D mesh dividing the node count), 'D' = 1-D over D devices,
-    # 'OxI' = 2-D torus (outer x inner; ring exchange only — the block
-    # shifts decompose into per-axis ICI rotations, parallel/mesh.py).
+    # 'OxI' = 2-D torus, 'SxOxI' = 3-D multi-slice torus (outermost
+    # axis over DCN).  Ring exchange only — the block shifts decompose
+    # into per-axis ring rotations (parallel/mesh.py,
+    # tpu_hash_sharded.make_block_send).
     MESH_SHAPE: str = ""
     # Per-node attribution of probe-recv / ack-send counters on the
     # jitted ring paths: 'exact' builds the [N]-index histograms (and,
@@ -222,11 +224,12 @@ class Params:
                     f"{getattr(self, knob)!r}")
         if self.MESH_SHAPE:
             parts = self.MESH_SHAPE.lower().split("x")
-            if not (1 <= len(parts) <= 2
+            if not (1 <= len(parts) <= 3
                     and all(p.isdigit() and int(p) > 0 for p in parts)):
                 raise ValueError(
-                    f"MESH_SHAPE must be 'D' or 'OxI' (positive ints), "
-                    f"got {self.MESH_SHAPE!r}")
+                    f"MESH_SHAPE must be 'D', 'OxI' or 'SxOxI' (positive "
+                    f"ints; 3-D = multi-slice torus, outermost axis over "
+                    f"DCN), got {self.MESH_SHAPE!r}")
             if self.BACKEND != "tpu_hash_sharded":
                 # Only the flagship sharded backend reads the key; the
                 # others build their own auto mesh and would silently run

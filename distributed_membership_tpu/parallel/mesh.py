@@ -61,6 +61,24 @@ def make_mesh2d(outer: int, inner: int) -> Mesh:
                 (NODE_OUTER, NODE_INNER))
 
 
+def make_torus_mesh(*dims: int) -> Mesh:
+    """An N-D torus mesh (major axis first).  2-D keeps make_mesh2d's
+    axis names; higher ranks name axes ``nodes_d0`` (outermost) …
+    ``nodes_d{N-1}``.  The intended 3-D reading is multi-slice: the
+    outermost axis spans slices over DCN, the inner two a slice's ICI
+    torus — the ring exchange's block shifts decompose per axis
+    (tpu_hash_sharded.make_block_send), so each gossip shift crosses
+    DCN at most twice (one mostly-zero carry stream) regardless of
+    slice count, and all other traffic stays on ICI."""
+    if len(dims) == 1:
+        return make_mesh(dims[0])
+    if len(dims) == 2:
+        return make_mesh2d(*dims)
+    devices = _take_devices(int(np.prod(dims)))
+    names = tuple(f"nodes_d{k}" for k in range(len(dims)))
+    return Mesh(np.asarray(devices).reshape(*dims), names)
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Shard axis 0 (the node axis) over the mesh (both axes if 2-D).
 

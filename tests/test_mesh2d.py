@@ -23,7 +23,8 @@ import pytest
 from distributed_membership_tpu.backends.tpu_hash_sharded import (
     run_scan_sharded)
 from distributed_membership_tpu.config import Params
-from distributed_membership_tpu.parallel.mesh import make_mesh, make_mesh2d
+from distributed_membership_tpu.parallel.mesh import (
+    make_mesh, make_mesh2d, make_torus_mesh)
 from distributed_membership_tpu.runtime.failures import make_plan
 
 
@@ -67,6 +68,22 @@ def test_2d_torus_bit_exact_4x2_and_8x1():
                                 collect_events=False)
         assert _mismatch(ref, s) == 0, (outer, inner)
         assert _mismatch(eref, e) == 0, (outer, inner)
+
+
+def test_3d_torus_bit_exact_vs_flat():
+    """The mixed-radix carry chain generalizes past two axes: a 2x2x2
+    torus (the multi-slice reading — outermost axis over DCN) reproduces
+    the flat 8-shard run bit-for-bit, including shifts that cascade a
+    carry through both minor axes."""
+    p = _params()
+    plan = make_plan(p, _pyrandom.Random("app:0"))
+    s1, e1 = run_scan_sharded(p, plan, seed=7, mesh=make_mesh(8),
+                              collect_events=False)
+    s3, e3 = run_scan_sharded(p, plan, seed=7,
+                              mesh=make_torus_mesh(2, 2, 2),
+                              collect_events=False)
+    assert _mismatch(s1, s3) == 0
+    assert _mismatch(e1, e3) == 0
 
 
 def test_2d_torus_folded_bit_exact_vs_flat():
