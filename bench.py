@@ -62,6 +62,18 @@ def _timed_runs(run_scan, params, plan, ticks):
     return time.perf_counter() - t0, final_state
 
 
+def _mode_str(frecv, fgossip, folded) -> str:
+    """One mode vocabulary for live AND banked rows ('folded',
+    'fused:recv|gossip|both', their '+' composition, or 'natural') so
+    identical programs never get distinct labels across code paths."""
+    fused = ("fused:both" if frecv and fgossip else
+             "fused:recv" if frecv else
+             "fused:gossip" if fgossip else "")
+    if folded:
+        return "folded" + (f"+{fused}" if fused else "")
+    return fused or "natural"
+
+
 def leg_hash(n: int, ticks: int, pin: str | None,
              view: int = 0) -> dict:
     import random as _pyrandom
@@ -132,9 +144,16 @@ def leg_hash(n: int, ticks: int, pin: str | None,
 
     return {
         "leg": "hash", "platform": platform, "n": n, "ticks": ticks,
-        "fused": fused, "folded": folded == "on",
-        "mode": ("folded" if folded == "on" else
-                 f"fused:{fused}" if fused != "off" else "natural"),
+        # Resolved state, not the env ask: under the auto knobs the
+        # fusegate may turn paths on (banked hardware evidence) or
+        # leave them off — the row must say which program actually ran.
+        # The ask travels under "requested".
+        "fused_receive": bool(cfg.fused_receive),
+        "fused_gossip": bool(cfg.fused_gossip),
+        "folded": bool(cfg.folded),
+        "requested": {"fused": fused, "folded": folded},
+        "mode": _mode_str(cfg.fused_receive, cfg.fused_gossip,
+                          cfg.folded),
         "node_ticks_per_sec": round(n * ticks / wall, 1),
         "wall_seconds": round(wall, 3),
         "ticks_per_sec": round(ticks / wall, 2),
@@ -212,12 +231,8 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
                 passes = 2 * 3 + 3 * min(r["fanout"], s)
                 gb_tick = passes * r["n"] * s * 4 / 1e9
                 gbps = round(gb_tick * r["ticks"] / r["wall_seconds"], 1)
-            mode = ("folded" if r.get("folded") else
-                    "fused:" + ("both" if r.get("fused") and
-                                r.get("fused_gossip") else
-                                "recv" if r.get("fused") else "gossip")
-                    if (r.get("fused") or r.get("fused_gossip"))
-                    else "natural")
+            mode = _mode_str(r.get("fused"), r.get("fused_gossip"),
+                             r.get("folded"))
             rows.append({
                 "n": r["n"],
                 "mode": mode,
