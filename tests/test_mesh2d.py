@@ -115,6 +115,37 @@ def test_2d_torus_cold_join_bit_exact_vs_flat():
     assert _mismatch(e1, e2) == 0
 
 
+@pytest.mark.quick
+def test_block_send_unit_every_shift():
+    """Unit contract of make_block_send on a 2x2x2 torus: for EVERY flat
+    shift b, the decomposed per-axis route delivers shard s's payload to
+    shard (s + b) mod 8 — i.e. it equals a flat roll of the
+    shard-indexed payload vector."""
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        make_block_send)
+
+    mesh = make_torus_mesh(2, 2, 2)
+    axes = tuple(mesh.axis_names)
+    send = make_block_send(8, axes, (2, 2, 2))
+
+    def f(x, b):
+        (out,) = send((x,), b)
+        return out
+
+    sharded = shard_map(f, mesh=mesh, in_specs=(P(axes), P()),
+                        out_specs=P(axes), check_vma=False)
+    payload = jnp.arange(16.0)      # shard s holds [2s, 2s+1]
+    for b in range(8):
+        out = np.asarray(sharded(payload, jnp.int32(b)))
+        expect = np.roll(np.asarray(payload).reshape(8, 2), b,
+                         axis=0).reshape(-1)
+        np.testing.assert_array_equal(out, expect, err_msg=f"b={b}")
+
+
 def test_2d_torus_rejects_scatter_exchange():
     p = _params()
     p.EXCHANGE = "scatter"
