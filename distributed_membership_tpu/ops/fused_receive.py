@@ -40,35 +40,42 @@ EMPTY = -1
 
 def _admit(n: int, self_mask, row_ids, view, incoming):
     """Sticky admit-or-refresh (tpu_hash.make_admit, inlined so the same
-    expression serves both the jnp path and the Pallas kernel body)."""
+    expression serves both the jnp path and the Pallas kernel body).
+    ``row_ids`` may be the plain [rows] vector (make_admit callers) or
+    the [rows, 1] column the all-2-D kernel body uses."""
+    rowc = row_ids if row_ids.ndim == 2 else row_ids[:, None]
     in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
     occupied = view > 0
     matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-    ok = jnp.where(self_mask, in_id == row_ids[:, None],
-                   ~occupied | matches)
+    ok = jnp.where(self_mask, in_id == rowc, ~occupied | matches)
     take = (incoming > 0) & ok
     return jnp.where(take, jnp.maximum(view, incoming), view)
 
 
 def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
-                  t, view, view_ts, mail, cand, recv_mask, act,
-                  self_on, self_pack, row_ids):
+                  t, view, view_ts, mail, cand, rcol, actc,
+                  sonc, spackc, rowc):
     """The shared computation (jnp ops only — legal in both contexts).
 
+    The per-node vectors arrive as COLUMN vectors ([rows, 1]): every use
+    broadcasts against the [rows, S] planes anyway, and all-2-D shapes
+    keep the Pallas twin free of 1-D refs/values, which Mosaic's TC
+    lowering handles far less robustly than lane-tiled 2-D (the same
+    reason fused_gossip's k_eff sidecar rides [rows, 1] planes).
+
     Returns (view, view_ts, mail_cleared, join_mask, rm_ids,
-    numfailed, size).
+    numfailed, size) — the last two as [rows, 1] columns.
     """
-    rcol = recv_mask[:, None]
     col = jax.lax.broadcasted_iota(I32, view.shape, 1)
     # slot_of(i, i) = i*(1+STRIDE) mod S, computed modularly (the overflow
     # guard of tpu_hash.slot_of).
     self_slot = jax.lax.rem(
-        jax.lax.rem(row_ids, s) * ((1 + stride) % s), s)
-    self_mask = col == self_slot[:, None]
+        jax.lax.rem(rowc, s) * ((1 + stride) % s), s)
+    self_mask = col == self_slot
 
     prev_present = view > 0
     # --- admit gossip mail (sticky admission) ---
-    admitted = _admit(n, self_mask, row_ids, view, mail)
+    admitted = _admit(n, self_mask, rowc, view, mail)
     new_view = jnp.where(rcol, admitted, view)
     changed = new_view > view
     new_ts = jnp.where(changed, t, view_ts)
@@ -84,21 +91,21 @@ def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
     new_ts = jnp.where(upd, t, new_ts)
 
     # --- self refresh (double heartbeat increment, caller packs) ---
-    s_on = self_mask & self_on[:, None]
-    new_view = jnp.where(s_on, self_pack[:, None], new_view)
+    s_on = self_mask & sonc
+    new_view = jnp.where(s_on, spackc, new_view)
     new_ts = jnp.where(s_on, t, new_ts)
 
     # --- TFAIL / TREMOVE sweep ---
     present = new_view > 0
     difft = t - new_ts
-    stale = present & (difft >= tfail) & act[:, None]
-    numfailed = stale.sum(1, dtype=I32)
+    stale = present & (difft >= tfail) & actc
+    numfailed = stale.sum(1, dtype=I32, keepdims=True)
     removes = stale & (difft >= tremove)
     cur_id = jnp.where(present,
                        ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
     rm_ids = jnp.where(removes, cur_id, EMPTY)
     new_view = jnp.where(removes, U32(0), new_view)
-    size = (new_view > 0).sum(1, dtype=I32)
+    size = (new_view > 0).sum(1, dtype=I32, keepdims=True)
 
     return (new_view, new_ts, mail_cleared, join_mask, rm_ids,
             numfailed, size)
@@ -107,10 +114,16 @@ def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
 def receive_core(n: int, s: int, tfail: int, tremove: int, stride: int,
                  t, view, view_ts, mail, cand, recv_mask, act,
                  self_on, self_pack, row_ids):
-    """Pure-jnp receive pass (reference AND default implementation)."""
-    return _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
-                         mail, cand, recv_mask, act, self_on, self_pack,
-                         row_ids)
+    """Pure-jnp receive pass (reference AND default implementation).
+    Takes the per-node vectors [N]-shaped; the column lifting/squeezing
+    happens here so callers are unchanged."""
+    (new_view, new_ts, mail_cleared, join_mask, rm_ids, nf, sz) = \
+        _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
+                      mail, cand, recv_mask[:, None], act[:, None],
+                      self_on[:, None], self_pack[:, None],
+                      row_ids[:, None])
+    return (new_view, new_ts, mail_cleared, join_mask, rm_ids,
+            nf[:, 0], sz[:, 0])
 
 
 def _pick_block(n: int) -> int:
@@ -163,7 +176,11 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
 
     row_spec = pl.BlockSpec((b, s), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
-    vec_spec = pl.BlockSpec((b,), lambda i: (i,),
+    # Per-node vectors ride as [rows, 1] planes: 1-D VMEM refs are the
+    # Mosaic TC pattern the gossip kernel already had to avoid — every
+    # use broadcasts against the [rows, S] planes anyway
+    # (_receive_body's column-vector contract).
+    col_spec = pl.BlockSpec((b, 1), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         kernel,
@@ -171,11 +188,11 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # t
             row_spec, row_spec, row_spec, row_spec,  # view, ts, mail, cand
-            vec_spec, vec_spec, vec_spec,            # recv, act, self_on
-            vec_spec, vec_spec,                      # self_pack, row_ids
+            col_spec, col_spec, col_spec,            # recv, act, self_on
+            col_spec, col_spec,                      # self_pack, row_ids
         ],
         out_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
-                   vec_spec, vec_spec],
+                   col_spec, col_spec],
         # Donate the big state buffers in place (view->view, ts->ts,
         # mail->mail_cleared): no duplicate [N, S] allocations live across
         # the call — the point of an HBM-roofline kernel.  (Input index 0
@@ -187,12 +204,12 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
             jax.ShapeDtypeStruct((rows, s), U32),   # mail cleared
             jax.ShapeDtypeStruct((rows, s), I32),   # join mask (i32)
             jax.ShapeDtypeStruct((rows, s), I32),   # rm ids
-            jax.ShapeDtypeStruct((rows,), I32),     # numfailed
-            jax.ShapeDtypeStruct((rows,), I32),     # size
+            jax.ShapeDtypeStruct((rows, 1), I32),   # numfailed
+            jax.ShapeDtypeStruct((rows, 1), I32),   # size
         ],
         interpret=interpret,
     )(jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
-      recv_mask.astype(I32), act.astype(I32), self_on.astype(I32),
-      self_pack, row_ids)
+      recv_mask.astype(I32)[:, None], act.astype(I32)[:, None],
+      self_on.astype(I32)[:, None], self_pack[:, None], row_ids[:, None])
     (view2, ts2, mailc, join_i, rm_ids, nf, size) = out
-    return view2, ts2, mailc, join_i != 0, rm_ids, nf, size
+    return (view2, ts2, mailc, join_i != 0, rm_ids, nf[:, 0], size[:, 0])
