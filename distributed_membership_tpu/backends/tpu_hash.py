@@ -92,7 +92,7 @@ from distributed_membership_tpu.observability.aggregates import (
     FAST_AGG_MAX_FAILED, AggStats, init_agg, init_fast_agg, update_agg,
     update_fast_agg)
 from distributed_membership_tpu.ops.fused_gossip import (
-    gossip_fused, gossip_fused_supported)
+    gossip_fused, gossip_fused_stacked, gossip_fused_supported)
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
@@ -387,17 +387,20 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
     if ring and cfg.probes >= s:
         raise ValueError("ring mode needs PROBES < VIEW_SIZE "
                          f"(got {cfg.probes} >= {s})")
-    if cfg.fused_gossip and (dynamic_knobs or cfg.drop_prob > 0
-                             or cfg.send_budget > 0
+    if cfg.fused_gossip and (dynamic_knobs or cfg.send_budget > 0
                              or not gossip_fused_supported(n, s)):
-        # Drops draw a per-shift [N, S] mask the kernel cannot replicate
-        # bit-exactly, and unsupported shapes need the two-roll wrapped-row
-        # column alignment the kernel omits (make_config rejects both too;
+        # Dynamic-knob sweeps vmap one compiled cell over the grid (no
+        # place for a per-cell kernel), the send budget is a sequential
+        # cross-shift mask the kernels don't model, and unsupported
+        # shapes need the two-roll wrapped-row column alignment the
+        # single-payload kernel omits (make_config rejects these too;
         # this guards direct make_step callers like the sweep driver).
+        # Static DROPS are fine: they ride the stacked kernel with
+        # pre-masked payloads (step body below).
         raise ValueError(
-            "FUSED_GOSSIP requires a static drop-free config and "
+            "FUSED_GOSSIP requires a static budget-free config and "
             f"supported shapes (got N={n}, S={s}, "
-            f"dynamic_knobs={dynamic_knobs}, drop={cfg.drop_prob})")
+            f"dynamic_knobs={dynamic_knobs}, budget={cfg.send_budget})")
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
     use_drop = dynamic_knobs or cfg.drop_prob > 0.0
@@ -600,6 +603,34 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     cnt = jnp.where(j < k_eff, c0, 0)
                     sent_gossip = sent_gossip + cnt
                     recv_add = recv_add + jnp.roll(cnt, shifts[j])
+            elif cfg.fused_gossip and k_max > 0:
+                # Lossy configs ride the sharded ring's STACKED kernel
+                # instead: the single-payload kernel cannot replicate the
+                # per-shift host-RNG drop masks, so each shift's payload
+                # is pre-masked outside with the EXACT draws the jnp loop
+                # makes (same fold_in stream — bit-exactness is the
+                # contract) and gossip_fused_stacked absorbs the local
+                # roll + column-align + max tail: ~(3K + 2) mail-sized
+                # passes vs the jnp loop's ~5K.  Widens the fast path to
+                # the msgdrop scenario class (VERDICT r3 "weak" 5).
+                payloads = []
+                for j in range(k_max):
+                    m = keep & (j < k_eff)[:, None]
+                    m = m & ~(jax.random.bernoulli(
+                        jax.random.fold_in(k_drop, j), p_drop, (n, s))
+                        & drop_active)
+                    payloads.append(jnp.where(m, view, U32(0)))
+                    cnt = m.sum(1, dtype=I32)
+                    sent_gossip = sent_gossip + cnt
+                    recv_add = recv_add + jnp.roll(cnt, shifts[j])
+                s1s = jax.lax.rem(jax.lax.rem(shifts, s) * cstride, s)
+                # gossip_fused_supported (checked above) implies
+                # (N*STRIDE) % S == 0: single column shift, so the
+                # kernel never reads its wrapped-row s2 operand.
+                mail = gossip_fused_stacked(
+                    n, s, k_max, True,
+                    jax.default_backend() != "tpu", mail,
+                    jnp.stack(payloads), shifts, s1s, s1s)
             else:
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
@@ -916,12 +947,16 @@ def make_config(params: Params, collect_events: bool = True,
                     and fused_supported(n, s)
                     and cleared("fused_receive", "fused_both"))
             if fg_knob == -1:
+                # Drop-free configs run the single-payload kernel; lossy
+                # ones the stacked variant — each auto-enables only on
+                # ITS OWN banked hardware family (fail closed).
                 fg_knob = int(
                     eligible and exchange == "ring"
                     and gossip_fused_supported(n, s)
-                    and params.effective_drop_prob() == 0
                     and send_budget_req == 0
-                    and cleared("fused_gossip", "fused_both"))
+                    and (cleared("fused_gossip", "fused_both")
+                         if params.effective_drop_prob() == 0
+                         else cleared("fused_gossip_drops")))
     fused = bool(fr_knob)
     if fused and exchange != "ring":
         raise ValueError("FUSED_RECEIVE requires the ring exchange")
@@ -966,12 +1001,6 @@ def make_config(params: Params, collect_events: bool = True,
                 f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
                 f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s}); for "
                 f"S < 128 combine it with FOLDED")
-        if fused_g and params.effective_drop_prob() > 0:
-            raise ValueError(
-                "FUSED_GOSSIP requires a drop-free config (the jnp path "
-                "draws a fresh per-shift drop mask the kernel cannot "
-                "replicate bit-exactly); the FOLDED stacked kernel "
-                "supports drops")
     send_budget = send_budget_req
     if send_budget:
         if exchange != "ring":

@@ -22,9 +22,12 @@ Supported when (enforced by :func:`gossip_fused_supported`):
 * ``(N * STRIDE) % S == 0`` — the wrapped/unwrapped receiver rows share
   one column shift, matching the jnp path's single-roll fast case
   (tpu_hash.py make_step: "they coincide iff N*STRIDE % S == 0");
-* no message drops — the jnp path draws a fresh [N, S] Bernoulli mask per
-  shift; replicating that stream in-kernel would fork the RNG semantics.
-  The drop-free configs are exactly the scale/bench regime.
+* no message drops FOR THIS KERNEL — the jnp path draws a fresh [N, S]
+  Bernoulli mask per shift; replicating that stream in-kernel would fork
+  the RNG semantics.  Lossy configs still fuse: the step pre-masks each
+  shift's payload outside with the exact jnp-path draws and routes
+  through :func:`gossip_fused_stacked` instead (tpu_hash.py make_step),
+  trading the single VMEM-resident payload for a [K, N, S] stack.
 
 Semantics are pinned bit-exactly against the jnp shift loop in interpret
 mode (tests/test_fused_gossip.py) and end-to-end via the FUSED_GOSSIP
@@ -93,9 +96,9 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
     replaces that local tail: the grid walks (mail block, shift) with the
     mail block VMEM-resident, sender rows arrive via scalar-prefetch
     block indexing from the stacked ``payloads [K, L, S]`` (already
-    sender-masked and ppermuted, so per-shift drop masks WOULD be
-    representable here — the shared config gate still keeps FUSED_GOSSIP
-    drop-free for uniformity with the single-chip kernel), and the
+    sender-masked — including per-shift drop masks, which both the
+    sharded ring and the single-chip lossy branch bake into the stack
+    before the call), and the
     column alignment applies ``s1s[j]`` — or the
     ``s2s[j]``/receiver-row select pair when ``single_col`` is False
     (the (L*STRIDE) % S != 0 wrapped-row case).  ~(2K + 2) local passes

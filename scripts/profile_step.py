@@ -165,4 +165,19 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:
+        # The ladder daemon surfaces only the stderr tail; bank the full
+        # traceback where a later session can read it.
+        import traceback
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts", "rung_errors.log")
+        with open(path, "a") as fh:
+            fh.write(f"=== profile_step {sys.argv[1:]} "
+                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+            traceback.print_exc(file=fh)
+        raise

@@ -5,9 +5,9 @@ The fused kernel (ops/fused_receive.py) is pinned bit-exactly against
 script closes the remaining gap — the actual Mosaic TPU lowering — by
 running the full `tpu_hash` scan under each mode on the real chip (same
 seed) and comparing final states bit-for-bit: the receive kernel under
-drops, the gossip kernel and the two-kernel composition drop-free, and
-the folded S=16 layout vs the natural one (droppy).  Exit 0 = all
-identical.  The comparison is same-platform only: each variant vs the
+drops, the gossip kernel and the two-kernel composition drop-free, the
+stacked gossip kernel under drops, and the folded S=16 layout vs the
+natural one (droppy).  Exit 0 = all identical.  The comparison is same-platform only: each variant vs the
 baseline on whatever backend resolve_platform selects.
 
 Run it whenever the relay is up:  python scripts/tpu_correctness.py
@@ -117,7 +117,13 @@ def main() -> int:
         base_d = run_once(False, False, True, n=args.n, ticks=args.ticks)
         recv_d = run_once(True, False, True, n=args.n, ticks=args.ticks)
         checks["fused_receive"] = diff(base_d, recv_d)
-        # Gossip kernel (drop-free by contract), alone and with the
+        # Gossip under drops rides the STACKED kernel (pre-masked
+        # payloads) — a different Mosaic program than the drop-free
+        # single-payload kernel, so it banks its own family and gates
+        # only the lossy configs' auto knob.
+        goss_d = run_once(False, True, True, n=args.n, ticks=args.ticks)
+        checks["fused_gossip_drops"] = diff(base_d, goss_d)
+        # Gossip kernel (single-payload, drop-free), alone and with the
         # receive kernel — the composition FUSED defaults would ship.
         base = run_once(False, False, False, n=args.n, ticks=args.ticks)
         goss = run_once(False, True, False, n=args.n, ticks=args.ticks)
@@ -164,6 +170,9 @@ def main() -> int:
         sh_recv_d = run_once_s(True, False, True, n=args.n,
                                ticks=args.ticks)
         checks["sharded_fused_receive"] = diff(sh_base_d, sh_recv_d)
+        sh_goss_d = run_once_s(False, True, True, n=args.n,
+                               ticks=args.ticks)
+        checks["sharded_fused_gossip_drops"] = diff(sh_base_d, sh_goss_d)
         sh_base = run_once_s(False, False, False, n=args.n,
                              ticks=args.ticks)
         sh_goss = run_once_s(False, True, False, n=args.n,
@@ -201,4 +210,19 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:
+        # The ladder daemon surfaces only the stderr tail; bank the full
+        # traceback where a later session can read it.
+        import time
+        import traceback
+
+        path = os.path.join(REPO, "artifacts", "rung_errors.log")
+        with open(path, "a") as fh:
+            fh.write(f"=== tpu_correctness {sys.argv[1:]} "
+                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+            traceback.print_exc(file=fh)
+        raise

@@ -172,7 +172,7 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                     return rec
             except (json.JSONDecodeError, IndexError):
                 pass
-        tail = (r.stderr or "").strip().splitlines()[-4:]
+        tail = (r.stderr or "").strip().splitlines()[-40:]
         print(f"  rung {name}: rc={r.returncode}\n    " + "\n    ".join(tail),
               flush=True)
         return None
@@ -244,11 +244,13 @@ def _corr_covers_ladder(rec) -> bool:
 # read as all of ITS OWN families dirty — fail closed for what it
 # covered, without smearing onto families another arm re-checks.
 ARM_FAMILIES = {
-    "fused_correctness": ("fused_receive", "fused_gossip", "fused_both"),
+    "fused_correctness": ("fused_receive", "fused_gossip", "fused_both",
+                          "fused_gossip_drops"),
     "folded_correctness": ("folded_s16", "folded_fused_s16",
                            "folded_s64", "folded_fused_s64"),
     "sharded_correctness": ("sharded_fused_receive",
                             "sharded_fused_gossip", "sharded_fused_both",
+                            "sharded_fused_gossip_drops",
                             "sharded_folded_s16",
                             "sharded_folded_fused_s16",
                             "sharded_folded_s64",

@@ -78,6 +78,10 @@ def pytest_collection_modifyitems(config, items):
     # silently drop out of the quick tier.  Checked only against files
     # that actually collected, so single-file runs still work; a
     # full-looking collection also checks the file names themselves.
+    # Node-id selections (`pytest file::test`) and -k filters collect a
+    # deliberate subset — no staleness signal there.
+    if any("::" in a for a in config.args) or config.option.keyword:
+        return
     stale = [f"{f}::{n}" for f, names in _QUICK.items() if f in seen
              for n in names - seen[f]]
     if len(seen) >= 10:

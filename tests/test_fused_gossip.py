@@ -106,16 +106,50 @@ def test_fused_run_matches_default_end_to_end():
     np.testing.assert_array_equal(np.asarray(fs0.mail), np.asarray(fs1.mail))
 
 
-def test_fused_gossip_with_drops_rejected():
+def test_fused_gossip_with_drops_end_to_end():
+    """A LOSSY config under FUSED_GOSSIP=1 must reproduce the unfused
+    lossy run exactly: the step pre-masks each shift's payload with the
+    same fold_in Bernoulli draws the jnp loop makes and routes through
+    the stacked kernel (tpu_hash.make_step droppy-fused branch)."""
+    import random
+
+    from distributed_membership_tpu.backends.tpu_hash import run_scan
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    def run(fused):
+        p = Params.from_text(
+            "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
+            "MSG_DROP_PROB: 0.15\nDROP_START: 20\nDROP_STOP: 110\n"
+            "VIEW_SIZE: 128\nGOSSIP_LEN: 16\nPROBES: 16\nTFAIL: 16\n"
+            "TREMOVE: 64\nTOTAL_TIME: 130\nFAIL_TIME: 70\nJOIN_MODE: warm\n"
+            f"EXCHANGE: ring\nFUSED_GOSSIP: {fused}\nBACKEND: tpu_hash\n")
+        plan = make_plan(p, random.Random("app:0"))
+        return run_scan(p, plan, seed=0)
+
+    fs0, ev0 = run(0)
+    fs1, ev1 = run(1)
+    np.testing.assert_array_equal(np.asarray(ev0.rm_ids),
+                                  np.asarray(ev1.rm_ids))
+    np.testing.assert_array_equal(np.asarray(ev0.sent), np.asarray(ev1.sent))
+    np.testing.assert_array_equal(np.asarray(ev0.recv), np.asarray(ev1.recv))
+    np.testing.assert_array_equal(np.asarray(fs0.view), np.asarray(fs1.view))
+    np.testing.assert_array_equal(np.asarray(fs0.view_ts),
+                                  np.asarray(fs1.view_ts))
+    np.testing.assert_array_equal(np.asarray(fs0.mail), np.asarray(fs1.mail))
+
+
+def test_fused_gossip_with_budget_rejected():
     from distributed_membership_tpu.backends.tpu_hash import make_config
     from distributed_membership_tpu.config import Params
 
     p = Params.from_text(
-        "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+        "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         "VIEW_SIZE: 128\nGOSSIP_LEN: 16\nPROBES: 16\nTFAIL: 16\n"
         "TREMOVE: 64\nTOTAL_TIME: 130\nFAIL_TIME: 70\nJOIN_MODE: warm\n"
-        "EXCHANGE: ring\nFUSED_GOSSIP: 1\nBACKEND: tpu_hash\n")
-    with pytest.raises(ValueError, match="drop-free"):
+        "EXCHANGE: ring\nFUSED_GOSSIP: 1\nENFORCE_BUFFSIZE: 1\n"
+        "BACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="ENFORCE_BUFFSIZE"):
         make_config(p)
 
 
@@ -174,6 +208,39 @@ def test_sharded_fused_gossip_end_to_end(n):
         p = Params.from_text(
             f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             "MSG_DROP_PROB: 0\nVIEW_SIZE: 128\nGOSSIP_LEN: 32\n"
+            "PROBES: 16\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+            "TOTAL_TIME: 100\nFAIL_TIME: 50\nJOIN_MODE: warm\n"
+            f"EVENT_MODE: agg\nEXCHANGE: ring\nFUSED_GOSSIP: {fg}\n"
+            "BACKEND: tpu_hash_sharded\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend("tpu_hash_sharded")(p, seed=0)
+
+    r0, r1 = run(0), run(1)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb", "pending_recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+
+
+def test_sharded_fused_gossip_drops_end_to_end():
+    """Lossy FUSED_GOSSIP on the sharded ring: the stacked payloads are
+    drop-masked at the sender before the ppermute, so the kernel needs
+    no drop awareness — the whole trajectory must still be bit-exact
+    against the unfused lossy run on the virtual mesh."""
+    import warnings
+
+    from distributed_membership_tpu.backends import get_backend
+    from distributed_membership_tpu.config import Params
+
+    def run(fg):
+        p = Params.from_text(
+            "MAX_NNB: 1024\nSINGLE_FAILURE: 1\nDROP_MSG: 1\n"
+            "MSG_DROP_PROB: 0.1\nDROP_START: 20\nDROP_STOP: 80\n"
+            "VIEW_SIZE: 128\nGOSSIP_LEN: 32\n"
             "PROBES: 16\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
             "TOTAL_TIME: 100\nFAIL_TIME: 50\nJOIN_MODE: warm\n"
             f"EVENT_MODE: agg\nEXCHANGE: ring\nFUSED_GOSSIP: {fg}\n"

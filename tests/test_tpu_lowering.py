@@ -80,6 +80,7 @@ VARIANTS = [
     ("frecv_small",    512, 128, True,  False, True,  False),
     ("fgossip",       4096, 128, False, True,  False, False),
     ("fgossip_small",  512, 128, False, True,  False, False),
+    ("fgossip_drops", 4096, 128, False, True,  True,  False),
     ("fboth",         4096, 128, True,  True,  False, False),
     ("folded_s16",    4096,  16, False, False, True,  False),
     ("folded_fboth_s16", 4096, 16, True, True, True,  False),
