@@ -85,7 +85,10 @@ def _folded_receive_body(n: int, tfail: int, tremove: int,
     in_id = ((mail - U32(1)) % U32(n)).astype(I32)
     occupied = view > 0
     matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-    ok = jnp.where(self_mask, in_id == node, ~occupied | matches)
+    # Bitwise, not jnp.where: an i1-branch select lowers to an i8->i1
+    # arith.trunci Mosaic's backend rejects (see ops/fused_receive._admit).
+    ok = ((self_mask & (in_id == node))
+          | (~self_mask & (~occupied | matches)))
     take = (mail > 0) & ok
     admitted = jnp.where(take, jnp.maximum(view, mail), view)
     new_view = jnp.where(rcol, admitted, view)

@@ -47,7 +47,12 @@ def _admit(n: int, self_mask, row_ids, view, incoming):
     in_id = ((incoming - U32(1)) % U32(n)).astype(I32)
     occupied = view > 0
     matches = in_id == ((view - U32(1)) % U32(n)).astype(I32)
-    ok = jnp.where(self_mask, in_id == rowc, ~occupied | matches)
+    # Boolean algebra, NOT jnp.where: a select between two i1 vectors
+    # reaches Mosaic's backend as an unsupported i8->i1 arith.trunci
+    # (real-chip compile failure the AOT .lower() gate cannot see —
+    # caught by the round-4 ladder, artifacts/rung_errors.log).
+    ok = ((self_mask & (in_id == rowc))
+          | (~self_mask & (~occupied | matches)))
     take = (incoming > 0) & ok
     return jnp.where(take, jnp.maximum(view, incoming), view)
 

@@ -21,7 +21,8 @@ holds; otherwise it quietly stays off (auto never raises — explicit
 The family keys mirror tpu_correctness.py's ``mismatched_elements``:
 ``fused_receive``, ``fused_gossip``, ``fused_both``,
 ``fused_gossip_drops`` (the stacked kernel on lossy configs),
-``folded_s{S}``, ``folded_fused_s{S}``, and their ``sharded_`` twins.  A missing record, a non-tpu record, or a family
+``folded_s{S}``, ``folded_fused_s{S}``, and their ``sharded_`` twins.
+A missing record, a non-tpu record, or a family
 absent from the record (e.g. a fold factor the correctness N could not
 fold) all read as NOT cleared — fail closed.
 """

@@ -7,8 +7,9 @@ running the full `tpu_hash` scan under each mode on the real chip (same
 seed) and comparing final states bit-for-bit: the receive kernel under
 drops, the gossip kernel and the two-kernel composition drop-free, the
 stacked gossip kernel under drops, and the folded S=16 layout vs the
-natural one (droppy).  Exit 0 = all identical.  The comparison is same-platform only: each variant vs the
-baseline on whatever backend resolve_platform selects.
+natural one (droppy).  Exit 0 = all identical.  The comparison is
+same-platform only: each variant vs the baseline on whatever backend
+resolve_platform selects.
 
 Run it whenever the relay is up:  python scripts/tpu_correctness.py
 """
