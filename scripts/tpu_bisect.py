@@ -1,0 +1,177 @@
+"""Bottleneck bisect for the scale step on real hardware.
+
+The first full ladder pass (artifacts/TPU_PROFILE.json, 2026-07-30)
+falsified the HBM-bound roofline at the north-star point: 1M_s16 runs at
+122 ms/tick — 13.7 GB/s effective, ~1.7% of a v5e's bandwidth — and the
+folded layout, which cuts the streamed bytes 8x, came out 2.3x SLOWER
+(276.8 ms/tick).  Whatever dominates those 122 ms, it is not bytes.  This
+probe decomposes the tick on-chip two ways:
+
+* config bisection — the same 1M_s16 step re-timed with one cost center
+  removed per variant: gossip fanout 3 -> 1 (per-shift cost from the
+  slope), entry thinning off (GOSSIP_LEN = VIEW_SIZE skips a [N, S]
+  uniform draw + the p_keep select), probe window widened 2 -> 8 (probe
+  pipeline slope);
+* op microbenches — jitted single ops at the exact step geometry
+  ([1M, 16] u32): one elementwise max pass, a row roll, a full gossip
+  shift (row roll + lane roll + max), a threefry uniform draw, and the
+  same max pass on the folded [N*S/128, 128] and padded-to-128 planes,
+  which prices the lane-padding tax directly.
+
+Output: ONE JSON line (ladder-bankable, no node_ticks_per_sec so the
+bench headline scanner ignores it).  Run via the ladder rung
+``bisect_1M_s16`` or directly:  python scripts/tpu_bisect.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def _micro(fn, *args, reps: int = 30) -> float:
+    """Median-free simple timer: jit, warm once, time ``reps`` calls."""
+    import jax
+
+    jfn = jax.jit(fn)
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def run_micro(n: int, s: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)
+    y = jnp.roll(x, 1, axis=0)
+    rows_f = (n * s) // 128
+    xf = x.reshape(rows_f, 128)
+    yf = y.reshape(rows_f, 128)
+    xp = jnp.pad(x, ((0, 0), (0, 128 - s))) if s < 128 else x
+    yp = jnp.roll(xp, 1, axis=0)
+
+    plane_gb = n * s * 4 / 1e9
+    out = {}
+
+    def bank(name, secs, passes_gb):
+        out[name] = {"ms": round(1000 * secs, 3),
+                     "eff_gbps": round(passes_gb / secs, 1)}
+
+    # One read+read+write elementwise pass at three layouts.
+    bank("max_natural", _micro(jnp.maximum, x, y), 3 * plane_gb)
+    bank("max_folded", _micro(jnp.maximum, xf, yf), 3 * plane_gb)
+    if s < 128:
+        bank("max_padded128", _micro(jnp.maximum, xp, yp),
+             3 * plane_gb * (128 // s))
+    # Row roll (the gossip delivery's data motion) and a full shift.
+    bank("roll_rows", _micro(lambda a: jnp.roll(a, 12345, axis=0), x),
+         2 * plane_gb)
+    bank("gossip_shift",
+         _micro(lambda a, b: jnp.maximum(
+             b, jnp.roll(jnp.roll(a, 12345, axis=0), 3, axis=1)), x, y),
+         4 * plane_gb)
+    # RNG: one [N, S] threefry uniform (the entry-thinning draw) and a
+    # [N] draw (control-plane scale).
+    bank("uniform_ns", _micro(
+        lambda k: jax.random.uniform(k, (n, s)), key), plane_gb)
+    bank("uniform_n", _micro(
+        lambda k: jax.random.uniform(k, (n,)), key), n * 4 / 1e9)
+    # [N]-vector op (probe pipeline currency).
+    v = jnp.arange(n, dtype=jnp.int32)
+    bank("vec_n_add", _micro(lambda a: a + 1, v), 2 * n * 4 / 1e9)
+    return out
+
+
+def run_variants(n: int, s: int, ticks: int) -> list:
+    # Not profile_step.time_point: that hardcodes GOSSIP_LEN = s//4 and
+    # PROBES = s//8, and the whole point here is moving those knobs.
+    import random as _pyrandom
+
+    import jax
+
+    from distributed_membership_tpu.backends.tpu_hash import run_scan
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.runtime.failures import make_plan
+
+    def point(tag, fanout, g, probes):
+        params = Params.from_text(
+            f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            f"MSG_DROP_PROB: 0\nVIEW_SIZE: {s}\nGOSSIP_LEN: {g}\n"
+            f"PROBES: {probes}\nFANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\n"
+            f"TOTAL_TIME: {ticks}\nFAIL_TIME: {ticks // 2}\n"
+            "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+            "BACKEND: tpu_hash\n")
+        plan = make_plan(params, _pyrandom.Random("app:0"))
+        fs, _ = run_scan(params, plan, seed=0, collect_events=False,
+                         total_time=ticks)
+        jax.block_until_ready(fs)
+        t0 = time.perf_counter()
+        fs, _ = run_scan(params, plan, seed=1, collect_events=False,
+                         total_time=ticks)
+        jax.block_until_ready(fs)
+        wall = time.perf_counter() - t0
+        return {"tag": tag, "fanout": fanout, "gossip_len": g,
+                "probes": probes,
+                "ms_per_tick": round(1000 * wall / ticks, 2)}
+
+    g0, p0 = max(s // 4, 1), max(s // 8, 1)
+    return [
+        point("full", 3, g0, p0),
+        point("fanout1", 1, g0, p0),
+        point("nothin", 3, s, p0),     # g >= s: no keep draw / p_keep
+        point("probes8", 3, g0, 8),
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--view", type=int, default=16)
+    ap.add_argument("--ticks", type=int, default=30)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    from distributed_membership_tpu.runtime.platform import resolve_platform
+    resolve_platform(pin=args.platform)
+
+    import jax
+
+    rec = {
+        "probe": "bisect",
+        "n": args.n, "s": args.view,
+        "platform": jax.default_backend(),
+        "timing": "warm_cache",
+        "micro": run_micro(args.n, args.view),
+        "variants": run_variants(args.n, args.view, args.ticks),
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:
+        import traceback
+
+        with open(os.path.join(REPO, "artifacts", "rung_errors.log"),
+                  "a") as fh:
+            fh.write(f"=== tpu_bisect {sys.argv[1:]} "
+                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+            traceback.print_exc(file=fh)
+        raise

@@ -61,6 +61,12 @@ SHARDED_CORR_RUNG = ("sharded_correctness", 8192, 128, 60, "off", 1800)
 # memory held by [N,16] vs [N,128] planes + padded-vs-folded gossip-op
 # timing; decides whether the folded layout is the next step.
 LAYOUT_RUNG = ("layout_probe", 1 << 20, 16, 0, "off", 420)
+# On-chip bottleneck decomposition at the north-star point: the first
+# ladder pass measured 1M_s16 at 1.7% of HBM bandwidth with folded
+# SLOWER than natural — the roofline's bytes-bound story is wrong there
+# and the next optimization needs to know what the 122 ms/tick actually
+# buys (scripts/tpu_bisect.py: config bisection + op microbenches).
+BISECT_RUNG = ("bisect_1M_s16", 1 << 20, 16, 30, "off", 1500)
 LADDER = [
     CORRECTNESS_RUNG,
     FOLDED_CORR_RUNG,
@@ -73,6 +79,7 @@ LADDER = [
     ("262k_s64",         1 << 18,  64,  60, "off",    420),
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
+    BISECT_RUNG,
     # Folded timeouts sized up from the first served pass: 1M_s16_folded
     # hit its 600 s wall while the relay was otherwise answering — the
     # folded step's segment-roll graph compiles noticeably slower than
@@ -136,6 +143,10 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_layout_probe.py"),
                "--n", str(n)]
+    elif name == BISECT_RUNG[0]:
+        cmd = [sys.executable,
+               os.path.join(REPO, "scripts", "tpu_bisect.py"),
+               "--n", str(n), "--view", str(s), "--ticks", str(ticks)]
     else:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
