@@ -54,6 +54,10 @@ def run_micro(n: int, s: int) -> dict:
     import jax
     import jax.numpy as jnp
 
+    if (n * s) % 128 != 0 or 128 % s != 0:
+        raise SystemExit(
+            f"bisect geometry needs S | 128 and (N*S) % 128 == 0 "
+            f"(got N={n}, S={s})")
     key = jax.random.PRNGKey(0)
     x = jax.random.randint(key, (n, s), 0, 1 << 20).astype(jnp.uint32)
     y = jnp.roll(x, 1, axis=0)
@@ -113,6 +117,10 @@ def run_variants(n: int, s: int, ticks: int) -> list:
             f"PROBES: {probes}\nFANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\n"
             f"TOTAL_TIME: {ticks}\nFAIL_TIME: {ticks // 2}\n"
             "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+            # Pinned OFF, not auto: once the correctness arms bank, auto
+            # would resolve FOLDED/FUSED on and this would bisect a
+            # different program than the 1M_s16 baseline under study.
+            "FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
             "BACKEND: tpu_hash\n")
         plan = make_plan(params, _pyrandom.Random("app:0"))
         fs, _ = run_scan(params, plan, seed=0, collect_events=False,
