@@ -233,6 +233,8 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
                 gbps = round(gb_tick * r["ticks"] / r["wall_seconds"], 1)
             mode = _mode_str(r.get("fused"), r.get("fused_gossip"),
                              r.get("folded"))
+            if r.get("prng", "threefry2x32") != "threefry2x32":
+                mode += f"+prng:{r['prng']}"
             rows.append({
                 "n": r["n"],
                 "mode": mode,

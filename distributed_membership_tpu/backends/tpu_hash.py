@@ -99,7 +99,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import (
     EMPTY, STRIDE, hash_slot)
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, make_plan, plan_tensors)
+    FailurePlan, make_plan, make_run_key, plan_tensors)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -1098,7 +1098,7 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     run = _get_runner(cfg, warm)
     final_state, events = run(
         keys, ticks, start_ticks, fail_mask, fail_time, drop_lo, drop_hi,
-        jax.random.PRNGKey(seed ^ 0x5EED))
+        make_run_key(params, seed ^ 0x5EED))
     return final_state, jax.tree.map(np.asarray, events)
 
 

@@ -147,6 +147,15 @@ class Params:
     # default to unbounded — see README "Network-semantics fidelity
     # notes" for the deviation list.
     ENFORCE_BUFFSIZE: int = 0
+    # PRNG implementation for the jitted backends' key streams:
+    # 'threefry2x32' (JAX default — deterministic across platforms and
+    # the implicit pin of every bit-exactness test) or 'rbg'
+    # (XLA's hardware RNG path — far cheaper on the TPU VPU, where the
+    # per-tick [N, S] threefry draws are dense u32 compute; trajectories
+    # change but stay protocol-valid, so scale/bench regimes can trade
+    # cross-run bit-stability for throughput).  The host/emul backends
+    # use Python RNG and ignore this key.
+    PRNG_IMPL: str = "threefry2x32"
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -214,6 +223,10 @@ class Params:
         if self.EXCHANGE not in ("auto", "scatter", "ring"):
             raise ValueError(
                 f"EXCHANGE must be auto|scatter|ring, got {self.EXCHANGE!r}")
+        if self.PRNG_IMPL not in ("threefry2x32", "rbg", "unsafe_rbg"):
+            raise ValueError(
+                f"PRNG_IMPL must be threefry2x32|rbg|unsafe_rbg, got "
+                f"{self.PRNG_IMPL!r}")
         if self.PROBE_IO not in ("auto", "exact", "approx"):
             raise ValueError(
                 f"PROBE_IO must be auto|exact|approx, got {self.PROBE_IO!r}")

@@ -64,6 +64,21 @@ def make_plan(params: Params, rng: random.Random) -> FailurePlan:
                        drop_start, drop_stop)
 
 
+def make_run_key(params: Params, seed: int):
+    """Root PRNG key under the configured implementation (PRNG_IMPL).
+
+    The default threefry2x32 stays the legacy raw-uint32 PRNGKey — the
+    implicit pin of every bit-exactness test; 'rbg'/'unsafe_rbg' return
+    typed key arrays that flow through the same split/fold_in stream but
+    draw via XLA's hardware RNG (cheap on the TPU VPU where threefry's
+    dense u32 rounds are real per-tick compute)."""
+    import jax
+
+    if params.PRNG_IMPL == "threefry2x32":
+        return jax.random.PRNGKey(seed)
+    return jax.random.key(seed, impl=params.PRNG_IMPL)
+
+
 def plan_tensors(params: Params, plan: FailurePlan, seed: int, total: int):
     """Convert a (params, plan, seed) triple into the tensor schedule every
     jitted backend consumes: ``(ticks, keys, start_ticks, fail_mask,
@@ -89,8 +104,8 @@ def plan_tensors(params: Params, plan: FailurePlan, seed: int, total: int):
     drop_hi = plan.drop_stop if plan.drop_stop is not None else total + 1
 
     ticks = jnp.arange(total, dtype=jnp.int32)
-    keys = jax.vmap(
-        lambda t: jax.random.fold_in(jax.random.PRNGKey(seed), t))(ticks)
+    root = make_run_key(params, seed)
+    keys = jax.vmap(lambda t: jax.random.fold_in(root, t))(ticks)
     return (ticks, keys, start_ticks, jnp.asarray(fail_mask),
             jnp.asarray(fail_time, jnp.int32), jnp.asarray(drop_lo, jnp.int32),
             jnp.asarray(drop_hi, jnp.int32))

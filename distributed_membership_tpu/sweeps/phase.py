@@ -99,14 +99,18 @@ def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
     drop_hi = jnp.asarray(total + 1, I32)
 
     def one_run(seed, fanout, drop):
+        # Key streams via make_run_key, the same root the backends use
+        # (honors PRNG_IMPL; seed is traced here — both impls accept it).
+        from distributed_membership_tpu.runtime.failures import make_run_key
+
         keys = jax.vmap(lambda t: jax.random.fold_in(
-            jax.random.PRNGKey(seed), t))(ticks)
+            make_run_key(params, seed), t))(ticks)
         # The crashed node varies with the seed, as Application::fail's
         # rand() % N does (Application.cpp:182).
-        failed = jax.random.randint(jax.random.PRNGKey(seed ^ 0xFA11),
+        failed = jax.random.randint(make_run_key(params, seed ^ 0xFA11),
                                     (), 0, n, dtype=I32)
         fail_mask = jnp.zeros((n,), bool).at[failed].set(True)
-        state0 = init_state_warm(cfg, jax.random.PRNGKey(seed ^ 0x5EED))
+        state0 = init_state_warm(cfg, make_run_key(params, seed ^ 0x5EED))
 
         def body(state, inp):
             t, k = inp

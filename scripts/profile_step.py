@@ -37,7 +37,8 @@ def _example_scan_args(params, plan, ticks):
 
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                fanout: int = 3, cost: bool = False,
-               fused_gossip: bool = False, folded: bool = False) -> dict:
+               fused_gossip: bool = False, folded: bool = False,
+               prng: str = "threefry2x32") -> dict:
     import random as _pyrandom
 
     import jax
@@ -56,7 +57,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
-        f"BACKEND: tpu_hash\n")
+        f"PRNG_IMPL: {prng}\nBACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
 
     t0 = time.perf_counter()
@@ -112,6 +113,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
+        "prng": prng,
         "fanout": cfg.fanout, "probes": cfg.probes,
         "platform": jax.default_backend(),
         # wall_seconds is a SECOND run on the warm jit cache; compile time
@@ -142,6 +144,8 @@ def main() -> int:
     ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
     ap.add_argument("--fused-gossip", default="off", choices=["off", "on"])
     ap.add_argument("--folded", default="off", choices=["off", "on"])
+    ap.add_argument("--prng", default="threefry2x32",
+                    choices=["threefry2x32", "rbg", "unsafe_rbg"])
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
@@ -159,7 +163,7 @@ def main() -> int:
             rec = time_point(n, args.view, args.ticks, args.exchange,
                              fused, args.fanout, cost=args.cost,
                              fused_gossip=args.fused_gossip == "on",
-                             folded=args.folded == "on")
+                             folded=args.folded == "on", prng=args.prng)
             print(json.dumps(rec), flush=True)
     return 0
 

@@ -93,6 +93,10 @@ def run_micro(n: int, s: int) -> dict:
         lambda k: jax.random.uniform(k, (n, s)), key), plane_gb)
     bank("uniform_n", _micro(
         lambda k: jax.random.uniform(k, (n,)), key), n * 4 / 1e9)
+    # Same draw on the hardware-RNG key impl (the PRNG_IMPL: rbg lever).
+    key_rbg = jax.random.key(0, impl="rbg")
+    bank("uniform_ns_rbg", _micro(
+        lambda k: jax.random.uniform(k, (n, s)), key_rbg), plane_gb)
     # [N]-vector op (probe pipeline currency).
     v = jnp.arange(n, dtype=jnp.int32)
     bank("vec_n_add", _micro(lambda a: a + 1, v), 2 * n * 4 / 1e9)

@@ -80,6 +80,17 @@ LADDER = [
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
     BISECT_RUNG,
+    # Natural-layout S=16 N-slope: with 1M_s16 at 122 ms/tick, linear
+    # scaling predicts ~7.6 ms at 65k — a superlinear break like the
+    # s64 262k->524k one (44->184 ms) would point at an N-dependent
+    # scheduling cliff rather than per-byte cost.
+    ("65k_s16",          1 << 16,  16, 150, "off",    240),
+    ("262k_s16",         1 << 18,  16, 100, "off",    300),
+    # PRNG_IMPL: rbg — same step, hardware-RNG key stream.  If the
+    # bisect fingers the threefry draws, this is the measured win; if
+    # not, it cheaply bounds the RNG share of the tick either way.
+    ("1M_s16_rbg",       1 << 20,  16,  60, "rbg",    600),
+    ("1M_s64_rbg",       1 << 20,  64,  60, "rbg",    900),
     # Folded timeouts sized up from the first served pass: 1M_s16_folded
     # hit its 600 s wall while the relay was otherwise answering — the
     # folded step's segment-roll graph compiles noticeably slower than
@@ -157,7 +168,8 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                "on" if fused in ("gossip", "both", "folded_fboth")
                else "off",
                "--folded",
-               "on" if fused in ("folded", "folded_fboth") else "off"]
+               "on" if fused in ("folded", "folded_fboth") else "off",
+               "--prng", "rbg" if fused == "rbg" else "threefry2x32"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True, env=env, cwd=REPO)
@@ -206,7 +218,10 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
-    if mode == "off" or corr is None:
+    if mode in ("off", "rbg") or corr is None:
+        # 'rbg' swaps the key-stream impl on the plain jnp step — no
+        # Pallas kernel in the program, so no correctness family gates it
+        # (its protocol validity is pinned in tests/test_hash_backend.py).
         return False
     if mode == "folded_fboth" and not _corr_covers_ladder(corr):
         # The verdict predates the folded_fused families: fail closed

@@ -220,3 +220,29 @@ def test_ring_cold_join_passes_grader(testcases_dir, scenario):
     result = get_backend("tpu_hash")(params, seed=3)
     g = grade_scenario(scenario, result.log.dbg_text(), 10)
     assert g.passed, (g.details, g.points, g.max_points)
+
+
+@pytest.mark.parametrize("impl", ["rbg", "unsafe_rbg"])
+def test_prng_impl_rbg_protocol_valid(impl):
+    """PRNG_IMPL swaps the key stream implementation (threefry ->
+    XLA's hardware RNG path — the TPU throughput lever when the dense
+    per-tick threefry draws dominate the step, PERF.md bisect).  The
+    trajectory legitimately changes, so this pins the PROTOCOL
+    contract instead: the crashed node is detected by every tracker
+    within the TFAIL..TREMOVE+slack window and nobody is falsely
+    removed."""
+    p, plan, fs, ev = _scale_run(exchange="ring", total=200,
+                                 extra=f"PRNG_IMPL: {impl}\n")
+    failed = plan.failed_indices[0]
+    rm = np.asarray(ev.rm_ids)
+    true_lat, false_rm = [], []
+    for t, i, s in zip(*np.nonzero(rm != -1)):
+        if rm[t, i, s] == failed and t > plan.fail_time:
+            true_lat.append(int(t) - plan.fail_time)
+        else:
+            false_rm.append((int(t), int(i), int(rm[t, i, s])))
+    assert not false_rm, false_rm[:10]
+    assert len(true_lat) >= p.VIEW_SIZE // 2, len(true_lat)
+    cycle = -(-p.VIEW_SIZE // p.PROBES)
+    assert max(true_lat) <= p.TREMOVE + 7 * cycle, sorted(true_lat)[-5:]
+    assert min(true_lat) >= p.TFAIL, sorted(true_lat)[:5]
