@@ -228,3 +228,23 @@ def test_ring_cold_join_under_drop_window():
     # each removed id is removed by many distinct observers.
     by_id = Counter(removed)
     assert by_id and min(by_id.values()) >= 10, by_id
+
+
+def test_prng_impl_rbg_on_mesh():
+    """PRNG_IMPL: rbg on the sharded ring — typed hardware-RNG keys must
+    survive the shard_map elaboration (per-shard fold_in, collective
+    plumbing) with the protocol contract intact."""
+    p = Params.from_text(
+        "MAX_NNB: 2048\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nFANOUT: 3\n"
+        "TOTAL_TIME: 150\nFAIL_TIME: 100\nJOIN_MODE: warm\n"
+        "EVENT_MODE: agg\nEXCHANGE: ring\nPRNG_IMPL: rbg\n"
+        "BACKEND: tpu_hash_sharded\n")
+    result = get_backend("tpu_hash_sharded")(p, seed=2)
+    assert result.extra["mesh_size"] == 8
+    s = result.extra["detection_summary"]
+    assert s["false_removals"] == 0
+    assert s["observer_completeness"] == 1.0
+    assert s["detection_completeness"] == 1.0
+    assert s["latency_min"] >= p.TFAIL
+    assert s["latency_max"] <= p.TREMOVE + p.VIEW_SIZE // p.PROBES + 12
