@@ -32,7 +32,7 @@ from distributed_membership_tpu.backends.tpu_hash import (
     _get_runner, make_config, plan_fail_ids)
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.runtime.failures import (
-    make_plan, plan_tensors)
+    make_plan, make_run_key, plan_tensors)
 
 TICKS = 60   # scan length is trace-invariant (body traced once); this
 #              matches scripts/tpu_correctness.py so the configs are
@@ -67,7 +67,7 @@ def _lower_for_tpu(params: Params) -> None:
      drop_lo, drop_hi) = plan_tensors(params, plan, 0, params.TOTAL_TIME)
     run = _get_runner(cfg, warm=True)
     run.trace(keys, ticks, start_ticks, fail_mask, fail_time, drop_lo,
-              drop_hi, jax.random.PRNGKey(7)).lower(
+              drop_hi, make_run_key(params, 7)).lower(
                   lowering_platforms=("tpu",))
 
 
@@ -100,3 +100,16 @@ VARIANTS = [
     VARIANTS, ids=[v[0] for v in VARIANTS])
 def test_full_scan_lowers_for_tpu(name, n, s, fr, fg, drops, folded):
     _lower_for_tpu(_conf(n, s, fr, fg, drops, folded))
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("impl", ["rbg", "unsafe_rbg"])
+def test_rbg_scan_lowers_for_tpu(impl):
+    """The PRNG_IMPL rbg ladder rungs must not discover a lowering gap on
+    the chip: the full scan with typed hardware-RNG keys (stablehlo
+    rng_bit_generator instead of the threefry custom call) has to make it
+    through the TPU pipeline like every Pallas variant does."""
+    p = _conf(4096, 16, False, False, False, True)
+    p.PRNG_IMPL = impl
+    p.validate()
+    _lower_for_tpu(p)
