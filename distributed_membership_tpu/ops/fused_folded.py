@@ -58,7 +58,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from distributed_membership_tpu.ops.fused_receive import _pick_block
+from distributed_membership_tpu.ops.fused_receive import _pick_block, umax
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -90,7 +90,7 @@ def _folded_receive_body(n: int, tfail: int, tremove: int,
     ok = ((self_mask & (in_id == node))
           | (~self_mask & (~occupied | matches)))
     take = (mail > 0) & ok
-    admitted = jnp.where(take, jnp.maximum(view, mail), view)
+    admitted = jnp.where(take, umax(view, mail), view)
     new_view = jnp.where(rcol, admitted, view)
     changed = new_view > view
     new_ts = jnp.where(changed, t, view_ts)
@@ -277,7 +277,7 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
         def _init():
             out_ref[:] = mail_ref[:]
 
-        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+        out_ref[:] = umax(out_ref[:], delivered)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,

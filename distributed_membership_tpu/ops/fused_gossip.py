@@ -47,7 +47,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from distributed_membership_tpu.ops.fused_receive import _pick_block
+from distributed_membership_tpu.ops.fused_receive import _pick_block, umax
 from distributed_membership_tpu.ops.view_merge import STRIDE
 
 I32 = jnp.int32
@@ -131,7 +131,7 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
         def _init():
             out_ref[:] = mail_ref[:]
 
-        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+        out_ref[:] = umax(out_ref[:], delivered)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -200,7 +200,7 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
         def _init():
             out_ref[:] = mail_ref[:]
 
-        out_ref[:] = jnp.maximum(out_ref[:], delivered)
+        out_ref[:] = umax(out_ref[:], delivered)
 
     row_block = lambda i, j, sh: (i, 0)           # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(

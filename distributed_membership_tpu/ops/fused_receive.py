@@ -38,6 +38,20 @@ U32 = jnp.uint32
 EMPTY = -1
 
 
+def umax(a, b):
+    """Unsigned elementwise max as compare+select.
+
+    ``jnp.maximum`` on u32 operands lowers to ``arith.maxui``, which
+    Mosaic's TPU backend fails to legalize on vectors (real-chip compile
+    failure, round-4 ladder: "failed to legalize operation 'arith.maxui'"
+    — artifacts/rung_errors.log; interpret mode and the AOT ``.lower()``
+    gate both accept it, so only hardware catches it).  The unsigned
+    compare predicate (``arith.cmpi ugt``) DOES legalize — the kernels
+    lean on it everywhere — so compare+select is the portable spelling.
+    Bit-identical to ``jnp.maximum`` for integers (no NaN cases)."""
+    return jnp.where(b > a, b, a)
+
+
 def _admit(n: int, self_mask, row_ids, view, incoming):
     """Sticky admit-or-refresh (tpu_hash.make_admit, inlined so the same
     expression serves both the jnp path and the Pallas kernel body).
@@ -54,7 +68,7 @@ def _admit(n: int, self_mask, row_ids, view, incoming):
     ok = ((self_mask & (in_id == rowc))
           | (~self_mask & (~occupied | matches)))
     take = (incoming > 0) & ok
-    return jnp.where(take, jnp.maximum(view, incoming), view)
+    return jnp.where(take, umax(view, incoming), view)
 
 
 def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,

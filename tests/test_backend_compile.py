@@ -1,0 +1,54 @@
+"""Deviceless TPU BACKEND-compile gate (scripts/aot_backend_compile.py).
+
+tests/test_tpu_lowering.py stops at ``.lower(lowering_platforms=
+("tpu",))`` — the Mosaic *kernel lowering* pipeline.  Round 4's ladder
+proved a deeper blind spot: Mosaic *backend legalization* inside libtpu
+rejects ops the lowering accepts (``arith.maxui`` on u32 vectors —
+artifacts/rung_errors.log), and that stage previously ran only via the
+flaky TPU relay.  The relay's own compile step is local though, and
+``jax.experimental.topologies`` exposes the same deviceless AOT path:
+compile the full scan against an abstract v5e mesh, zero TPU time.
+
+Subprocess-based: the compile must run in an interpreter whose
+environment never loaded the axon relay plugin (sitecustomize registers
+it at startup and dials the relay), and the script's re-exec guard
+handles that scrubbing itself.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "aot_backend_compile.py")
+
+
+def _run(variant: str | None, timeout: float) -> None:
+    cmd = [sys.executable, SCRIPT]
+    if variant:
+        cmd += ["--variant", variant]
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout, cwd=REPO)
+    if "no TPU topology support" in r.stdout:
+        pytest.skip("libtpu topology unavailable on this host")
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr[-2000:]}"
+
+
+@pytest.mark.quick
+def test_north_star_variant_backend_compiles():
+    """The folded+fused S=16 scan — the north-star config point — must
+    pass the complete XLA:TPU + Mosaic backend pipeline.  In the quick
+    tier: this is the exact failure class that cost round 3 its entire
+    hardware perf story."""
+    _run("folded_fboth_s16", timeout=300)
+
+
+def test_all_variants_backend_compile():
+    """Every Pallas/folded/sharded scan variant backend-compiles for TPU
+    (the full sweep, ~2 min; the ladder's hardware correctness rungs
+    remain the runtime bit-exactness gate)."""
+    _run(None, timeout=900)
