@@ -19,8 +19,11 @@ probe decomposes the tick on-chip two ways:
   which prices the lane-padding tax directly.
 
 Output: ONE JSON line (ladder-bankable, no node_ticks_per_sec so the
-bench headline scanner ignores it).  Run via the ladder rung
-``bisect_1M_s16`` or directly:  python scripts/tpu_bisect.py
+bench headline scanner ignores it).  Run via the phased ladder rungs
+``bisect_{micro,cfga,cfgb,cfgc}_1M_s16`` — each phase banks on its own,
+so a relay flake costs one phase, not the set — or directly:
+``python scripts/tpu_bisect.py --phase micro`` (``--phase all`` runs
+everything in-process).
 """
 
 from __future__ import annotations
@@ -100,10 +103,31 @@ def run_micro(n: int, s: int) -> dict:
     # [N]-vector op (probe pipeline currency).
     v = jnp.arange(n, dtype=jnp.int32)
     bank("vec_n_add", _micro(lambda a: a + 1, v), 2 * n * 4 / 1e9)
+    # Random-index gather, the probe/ack pipeline's access pattern: the
+    # optimized 1M_s16 HLO has four [N, P] gathers from [N] tables per
+    # tick (hb_ack = vec[id2], act[tgt1], will_flush[tgt1]) — random
+    # access is the op class TPUs handle worst, and the local AOT census
+    # cannot price it (XLA's gather cost model is nominal).  P=2 at the
+    # north-star config.
+    p_cnt = max(s // 8, 1)
+    idx2 = jax.random.randint(key, (n, p_cnt), 0, n)
+    bank("gather_np_from_n", _micro(lambda a, i: a[i], v, idx2),
+         (2 * n * p_cnt + n) * 4 / 1e9)   # idx read + out write + table
+    # Dynamic lane roll of the [N, S] plane (probe window + gossip column
+    # alignment): minor-dim rotation by a traced scalar.
+    sh = jnp.asarray(3, jnp.int32)
+    bank("roll_lanes_dyn", _micro(
+        lambda a, r: jnp.roll(a, r, axis=1), x, sh), 2 * plane_gb)
+    # Dynamic row roll (the gossip shifts are traced values, not the
+    # static 12345 above — XLA picks a different lowering for dynamic
+    # starts).
+    rr = jnp.asarray(12345, jnp.int32)
+    bank("roll_rows_dyn", _micro(
+        lambda a, r: jnp.roll(a, r, axis=0), x, rr), 2 * plane_gb)
     return out
 
 
-def run_variants(n: int, s: int, ticks: int) -> list:
+def run_variants(n: int, s: int, ticks: int, tags) -> list:
     # Not profile_step.time_point: that hardcodes GOSSIP_LEN = s//4 and
     # PROBES = s//8, and the whole point here is moving those knobs.
     import random as _pyrandom
@@ -140,12 +164,27 @@ def run_variants(n: int, s: int, ticks: int) -> list:
                 "ms_per_tick": round(1000 * wall / ticks, 2)}
 
     g0, p0 = max(s // 4, 1), max(s // 8, 1)
-    return [
-        point("full", 3, g0, p0),
-        point("fanout1", 1, g0, p0),
-        point("nothin", 3, s, p0),     # g >= s: no keep draw / p_keep
-        point("probes8", 3, g0, 8),
-    ]
+    specs = {
+        "full": (3, g0, p0),
+        "fanout1": (1, g0, p0),
+        "nothin": (3, s, p0),   # g >= s: no keep draw / p_keep
+        "probes8": (3, g0, 8),
+        # Probes OFF entirely: kills the ack-gather pipeline (the [N, P]
+        # random gathers the HLO census flagged), not just its width.
+        "noprobe": (3, g0, 0),
+    }
+    return [point(tag, *specs[tag]) for tag in tags]
+
+
+# Phases, separately bankable: the single monolithic rung timed out at
+# 1500 s against the flaky relay and banked NOTHING — each phase is now
+# its own ladder rung sized to one-or-two compiles of wall clock.
+PHASES = {
+    "micro": None,                       # op microbenches only
+    "cfg_a": ("full", "fanout1"),        # baseline + gossip slope
+    "cfg_b": ("nothin", "probes8"),      # thinning draw + probe width
+    "cfg_c": ("noprobe",),               # ack-gather pipeline removal
+}
 
 
 def main() -> int:
@@ -154,6 +193,8 @@ def main() -> int:
     ap.add_argument("--view", type=int, default=16)
     ap.add_argument("--ticks", type=int, default=30)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--phase", default="all",
+                    choices=("all",) + tuple(PHASES))
     args = ap.parse_args()
 
     from distributed_membership_tpu.runtime.platform import resolve_platform
@@ -162,13 +203,18 @@ def main() -> int:
     import jax
 
     rec = {
-        "probe": "bisect",
+        "probe": f"bisect_{args.phase}",
         "n": args.n, "s": args.view,
         "platform": jax.default_backend(),
         "timing": "warm_cache",
-        "micro": run_micro(args.n, args.view),
-        "variants": run_variants(args.n, args.view, args.ticks),
     }
+    phases = tuple(PHASES) if args.phase == "all" else (args.phase,)
+    for ph in phases:
+        if ph == "micro":
+            rec["micro"] = run_micro(args.n, args.view)
+        else:
+            rec.setdefault("variants", []).extend(
+                run_variants(args.n, args.view, args.ticks, PHASES[ph]))
     print(json.dumps(rec), flush=True)
     return 0
 

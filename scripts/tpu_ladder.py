@@ -66,7 +66,21 @@ LAYOUT_RUNG = ("layout_probe", 1 << 20, 16, 0, "off", 420)
 # SLOWER than natural — the roofline's bytes-bound story is wrong there
 # and the next optimization needs to know what the 122 ms/tick actually
 # buys (scripts/tpu_bisect.py: config bisection + op microbenches).
-BISECT_RUNG = ("bisect_1M_s16", 1 << 20, 16, 30, "off", 1500)
+# Phased (micro / cfg_a / cfg_b / cfg_c): the monolithic 1500 s rung
+# timed out against the relay and banked nothing — each phase banks on
+# its own.  The local AOT HLO census (round 4) narrowed the suspects to
+# the threefry fusions (~9G element-ops/tick) and four [N, P]
+# random-index gathers in the probe/ack pipeline; the micro phase now
+# prices both directly.
+BISECT_RUNGS = [
+    ("bisect_micro_1M_s16", 1 << 20, 16, 30, "micro", 700),
+    ("bisect_cfga_1M_s16", 1 << 20, 16, 30, "cfg_a", 700),
+    ("bisect_cfgb_1M_s16", 1 << 20, 16, 30, "cfg_b", 700),
+    ("bisect_cfgc_1M_s16", 1 << 20, 16, 30, "cfg_c", 500),
+]
+# Derived, not hand-copied: a new phase rung added above must get the
+# same no-Pallas gating exemption without a second edit site.
+BISECT_PHASES = frozenset(r[4] for r in BISECT_RUNGS)
 LADDER = [
     CORRECTNESS_RUNG,
     FOLDED_CORR_RUNG,
@@ -79,7 +93,7 @@ LADDER = [
     ("262k_s64",         1 << 18,  64,  60, "off",    420),
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
-    BISECT_RUNG,
+    *BISECT_RUNGS,
     # Natural-layout S=16 N-slope: with 1M_s16 at 122 ms/tick, linear
     # scaling predicts ~7.6 ms at 65k — a superlinear break like the
     # s64 262k->524k one (44->184 ms) would point at an N-dependent
@@ -154,10 +168,11 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_layout_probe.py"),
                "--n", str(n)]
-    elif name == BISECT_RUNG[0]:
+    elif name.startswith("bisect_"):
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "tpu_bisect.py"),
-               "--n", str(n), "--view", str(s), "--ticks", str(ticks)]
+               "--n", str(n), "--view", str(s), "--ticks", str(ticks),
+               "--phase", fused]   # phase rides the mode slot
     else:
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
@@ -218,7 +233,7 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
-    if mode in ("off", "rbg") or corr is None:
+    if mode in ("off", "rbg") or mode in BISECT_PHASES or corr is None:
         # 'rbg' swaps the key-stream impl on the plain jnp step — no
         # Pallas kernel in the program, so no correctness family gates it
         # (its protocol validity is pinned in tests/test_hash_backend.py).
