@@ -139,6 +139,25 @@ def _will_flush(recv_mask, fail_mask, t, fail_time):
     return recv_mask & ~(fail_mask & (t == fail_time))
 
 
+def _pack_probe_bits(will_flush, act):
+    """Pack the two per-target filter bits of the approx probe-attribution
+    branch into ONE i32 table (bit0 = will_flush, bit1 = act): ``act[tgt1]``
+    and ``will_flush[tgt1]`` share their index tensor, and random [N, P]
+    gathers are the op class the 1M_s16 HLO census flagged — pay the
+    random access once.  Unpack with the companions below; all four
+    backends (natural/folded x single/sharded) must use these so the bit
+    layout cannot drift between the bit-exactness twins."""
+    return will_flush.astype(I32) | (act.astype(I32) << 1)
+
+
+def _gathered_flush(packed):
+    return (packed & 1) != 0
+
+
+def _gathered_act(packed):
+    return packed >= 2      # values are 0..3; bit1 set iff >= 2
+
+
 def _credit_orphan_recvs(per_prober, will_flush):
     """Approx probe-recv attribution, single chip: keep rows that will
     flush; recvs counted for a non-flushing prober (already dead — its
@@ -791,10 +810,11 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # _credit_orphan_recvs.
                 will_flush = _will_flush(recv_mask, fail_mask, t,
                                          fail_time)
-                per_prober = (v1 & will_flush[tgt1]).sum(1, dtype=I32) \
-                    * p_red
+                packed_g = _pack_probe_bits(will_flush, act)[tgt1]
+                per_prober = (v1 & _gathered_flush(packed_g)).sum(
+                    1, dtype=I32) * p_red
                 recv_probe = _credit_orphan_recvs(per_prober, will_flush)
-                sent_ack = (v1 & act[tgt1]).sum(1, dtype=I32)
+                sent_ack = (v1 & _gathered_act(packed_g)).sum(1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
         elif cfg.probes > 0:

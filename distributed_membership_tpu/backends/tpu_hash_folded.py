@@ -362,13 +362,19 @@ def make_folded_step(cfg):
                 # of tpu_hash.make_step's scale branch on folded planes
                 # (see _will_flush / _credit_orphan_recvs there).
                 from distributed_membership_tpu.backends.tpu_hash import (
-                    _credit_orphan_recvs, _will_flush)
+                    _credit_orphan_recvs, _gathered_act, _gathered_flush,
+                    _pack_probe_bits, _will_flush)
                 will_flush = _will_flush(recv_mask, fail_mask, t,
                                          fail_time)
+                # One packed random gather for both per-target bits
+                # (tpu_hash.make_step's scale-branch packing, on the
+                # folded planes).
+                packed_g = _pack_probe_bits(will_flush, act)[tgt1]
                 per_prober = psum_row(
-                    (v1 & will_flush[tgt1]).astype(I32)) * p_red
+                    (v1 & _gathered_flush(packed_g)).astype(I32)) * p_red
                 recv_probe = _credit_orphan_recvs(per_prober, will_flush)
-                sent_ack = psum_row((v1 & act[tgt1]).astype(I32))
+                sent_ack = psum_row(
+                    (v1 & _gathered_act(packed_g)).astype(I32))
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
@@ -589,8 +595,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)    # global target ids
             act_g = lax.all_gather(act, AX, tiled=True)      # [N]
-            ack_send = v1 & act_g[tgt1]
             if cfg.count_probe_io:
+                ack_send = v1 & act_g[tgt1]
                 recv_hist = jnp.zeros((n + 1,), I32).at[
                     jnp.where(v1, tgt1, n).reshape(-1)].add(
                         p_red, mode="drop")[:n]
@@ -603,17 +609,22 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                     ack_hist, AX, scatter_dimension=0, tiled=True)
             else:
                 from distributed_membership_tpu.backends.tpu_hash import (
-                    _credit_orphan_recvs_sharded, _will_flush)
+                    _credit_orphan_recvs_sharded, _gathered_act,
+                    _gathered_flush, _pack_probe_bits, _will_flush)
                 will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
                                            fail_time)
                 will_flush_g = lax.all_gather(
                     will_flush_l, AX, tiled=True)            # [N]
+                # One packed random gather for both per-target bits
+                # (act + will_flush share tgt1).
+                packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]
                 per_prober = psum_row(
-                    (v1 & will_flush_g[tgt1]).astype(I32)) * p_red
+                    (v1 & _gathered_flush(packed_g)).astype(I32)) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(
                     per_prober, will_flush_l, will_flush_g, lrows,
                     AX)
-                sent_ack = psum_row(ack_send.astype(I32))
+                sent_ack = psum_row(
+                    (v1 & _gathered_act(packed_g)).astype(I32))
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
 

@@ -67,6 +67,7 @@ from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_hash import (
     STRIDE, HashConfig, I32, U32, _credit_orphan_recvs_sharded,
+    _gathered_act, _gathered_flush, _pack_probe_bits,
     _will_flush, make_admit, make_config, pack, slot_of, unpack)
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
@@ -624,8 +625,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # sends to targets, and BOTH branches need the act-of-target
             # filter for exact totals (dead targets send no ack).
             act_g = lax.all_gather(act, AX, tiled=True)     # [N]
-            ack_send = v1 & act_g[tgt1]
             if cfg.count_probe_io:
+                ack_send = v1 & act_g[tgt1]
                 # Exact per-target attribution (tpu_hash.make_step's
                 # exact branch, distributed): local histograms over the
                 # GLOBAL index space, summed-and-sliced back to the
@@ -648,12 +649,17 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                                            fail_time)
                 will_flush_g = lax.all_gather(
                     will_flush_l, AX, tiled=True)        # [N]
-                per_prober = (v1 & will_flush_g[tgt1]).sum(
+                # One packed random gather for both per-target bits
+                # (act + will_flush share tgt1) — the single-chip scale
+                # branch's packing, distributed.
+                packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]
+                per_prober = (v1 & _gathered_flush(packed_g)).sum(
                     1, dtype=I32) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(
                     per_prober, will_flush_l, will_flush_g, lrows,
                     AX)
-                sent_ack = ack_send.sum(1, dtype=I32)
+                sent_ack = (v1 & _gathered_act(packed_g)).sum(
+                    1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
