@@ -86,7 +86,17 @@ def main() -> int:
     from distributed_membership_tpu.backends import get_backend
     from distributed_membership_tpu.config import Params
 
-    cycle = -(-args.view // args.probes)
+    if args.probes > 0:
+        cycle = -(-args.view // args.probes)
+    else:
+        # Probes off (the bisect's noprobe regime): entries refresh via
+        # gossip only.  A tracked id arrives with a fresh heartbeat when
+        # any of the ``fanout`` senders includes it in its ~G-entry
+        # subset: expected interval ~ S / (fanout * G) ticks; round up
+        # and keep the same 2x/5x TFAIL/TREMOVE ladder the probe sizing
+        # uses so the verdict gates stay comparable.
+        g = args.gossip if args.gossip > 0 else max(args.view // 4, 1)
+        cycle = max(-(-args.view // max(args.fanout * g, 1)), 1)
     tfail = 2 * cycle
     k_cycles = args.tremove_cycles
     if k_cycles == 0:
