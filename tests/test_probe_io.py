@@ -67,3 +67,26 @@ def test_dead_target_sends_no_ack_in_either_mode():
     # ack leaked in approx mode, test_totals_equal_split_differs would
     # already have caught the drift — here we pin the exact-side zero.
     assert s_ex[failed, fail_time + 2:].sum() == 0
+
+
+@pytest.mark.quick
+def test_pack_probe_bits_roundtrip():
+    """The shared bit layout of the packed per-target gather table
+    (bit0 = will_flush, bit1 = act) must unpack to exactly the two
+    source predicates — all four backends share these helpers so the
+    bit-exactness twins cannot drift (see _pack_probe_bits)."""
+    import itertools
+
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _gathered_act, _gathered_flush, _pack_probe_bits)
+
+    combos = jnp.asarray(list(itertools.product([False, True], repeat=2)))
+    wf, act = combos[:, 0], combos[:, 1]
+    packed = _pack_probe_bits(wf, act)
+    assert packed.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(_gathered_flush(packed)),
+                                  np.asarray(wf))
+    np.testing.assert_array_equal(np.asarray(_gathered_act(packed)),
+                                  np.asarray(act))
