@@ -1,0 +1,69 @@
+"""Submission-client protocol compatibility (scripts/submit.py).
+
+The reference's uploader speaks a form protocol (reference
+submit.py:83-134): pipe-delimited challenge, sha1(challenge+password)
+response, and a submit form carrying base64 dbg.log.  These tests pin the
+rebuilt payloads to that shape — the transport (offline file vs live
+endpoint) is the only thing that may differ.
+"""
+
+import base64
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from submit import (  # noqa: E402
+    PART_IDS, challenge_request_payload, challenge_response,
+    parse_challenge, submission_payload)
+
+
+@pytest.mark.quick
+def test_challenge_response_is_sha1_of_challenge_then_password():
+    # reference submit.py:99-106: sha1.update(challenge + password)
+    assert challenge_response("pw", "ch") == hashlib.sha1(
+        b"chpw").hexdigest()
+
+
+@pytest.mark.quick
+def test_parse_challenge_nine_field_contract():
+    # reference submit.py:92-97: email|ch|state|ch_aux at odd indices
+    text = "e|mail@x|E|c|CH|s|ST|a|AUX"
+    assert parse_challenge(text) == ("E", "CH", "ST", "AUX")
+    with pytest.raises(ValueError):
+        parse_challenge("too|few|fields")
+
+
+@pytest.mark.quick
+def test_submission_payload_fields_and_b64():
+    # reference submit.py:116-127: base64 dbg.log as submission AND aux
+    p = submission_payload("e@x", PART_IDS[0], b"131\n log", "resp", "st")
+    assert sorted(p) == ["assignment_part_sid", "challenge_response",
+                         "email_address", "state", "submission",
+                         "submission_aux"]
+    assert base64.b64decode(p["submission"]) == b"131\n log"
+    assert p["submission"] == p["submission_aux"]
+    assert challenge_request_payload("e@x", "mp1_part1")[
+        "response_encoding"] == "delim"
+
+
+def test_offline_submission_end_to_end(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "submit.py"),
+         "--part", "1", "--backend", "emul", "--email", "a@b.c",
+         "--password", "pw", "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={"DM_RESOLVED_PLATFORM": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": "/root"})
+    assert r.returncode == 0, r.stderr[-1500:]
+    payload = json.loads(
+        (tmp_path / "submission_mp1_part1.json").read_text())
+    assert payload["grade"]["points"] == 30
+    dbg = base64.b64decode(payload["submit_request"]["submission"])
+    assert dbg.splitlines()[0] == b"131"
