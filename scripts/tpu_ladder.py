@@ -85,6 +85,12 @@ LADDER = [
     CORRECTNESS_RUNG,
     FOLDED_CORR_RUNG,
     LAYOUT_RUNG,
+    # Decision-critical first (the relay serves in short windows): the
+    # bisect micro + the probes-off point attribute the 1M_s16 122
+    # ms/tick between gathers / RNG / rolls — that answer picks the
+    # next optimization, so it must land before nice-to-have timing.
+    BISECT_RUNGS[0],                      # micro: op benches
+    BISECT_RUNGS[3],                      # cfg_c: noprobe
     ("65k_s64",          1 << 16,  64, 150, "off",    240),
     ("65k_s128",         1 << 16, 128, 100, "off",    300),
     ("65k_s128_frecv",   1 << 16, 128, 100, "recv",   300),
@@ -93,7 +99,8 @@ LADDER = [
     ("262k_s64",         1 << 18,  64,  60, "off",    420),
     ("262k_s128",        1 << 18, 128,  60, "off",    480),
     ("1M_s16",           1 << 20,  16,  60, "off",    600),
-    *BISECT_RUNGS,
+    BISECT_RUNGS[1],                      # cfg_a: full + fanout slope
+    BISECT_RUNGS[2],                      # cfg_b: thinning + probe width
     # Natural-layout S=16 N-slope: with 1M_s16 at 122 ms/tick, linear
     # scaling predicts ~7.6 ms at 65k — a superlinear break like the
     # s64 262k->524k one (44->184 ms) would point at an N-dependent
