@@ -225,6 +225,10 @@ class HashConfig:
     count_probe_io: bool = True  # exact per-node probe/ack recv counters
     #                              (two [N*P]-index histograms per tick);
     #                              off at huge N, totals stay ~exact
+    probe_io_none: bool = False  # PROFILING ONLY (PROBE_IO: none): zero
+    #                              the probe-recv/ack-send counters,
+    #                              removing their per-target random
+    #                              gather from the tick
     fused_receive: bool = False  # ring receive via the Pallas one-pass
     #                              kernel (ops/fused_receive) instead of
     #                              the jnp expression of the same math
@@ -798,6 +802,12 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 sent_ack = jnp.zeros((n + 1,), I32).at[
                     jnp.where(ack_send, tgt1, n).reshape(-1)].add(
                         1, mode="drop")[:n]
+            elif cfg.probe_io_none:
+                # PROFILING ONLY (PROBE_IO: none): zero the
+                # probe-recv/ack-send counters — no per-target gather in
+                # the tick (probe sends / ack recvs are still counted).
+                recv_probe = jnp.zeros((n,), I32)
+                sent_ack = jnp.zeros((n,), I32)
             else:
                 # Scale mode: same global volume, attributed to the
                 # prober's row (per-node probe recv/ack-send counters
@@ -1059,6 +1069,7 @@ def make_config(params: Params, collect_events: bool = True,
         count_probe_io=(n <= PROBE_IO_EXACT_MAX
                         if params.PROBE_IO == "auto"
                         else params.PROBE_IO == "exact"),
+        probe_io_none=params.PROBE_IO == "none",
         fused_receive=fused, fused_gossip=fused_g, folded=folded,
         send_budget=send_budget)
 

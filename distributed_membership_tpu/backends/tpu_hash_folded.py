@@ -357,6 +357,12 @@ def make_folded_step(cfg):
                 sent_ack = jnp.zeros((n + 1,), I32).at[
                     jnp.where(ack_send, tgt1, n).reshape(-1)].add(
                         1, mode="drop")[:n]
+            elif cfg.probe_io_none:
+                # PROFILING ONLY (PROBE_IO: none): zero the probe-recv/
+                # ack-send counters, no per-target gather — probe sends /
+                # ack recvs still counted (tpu_hash.make_step's twin).
+                recv_probe = jnp.zeros((n,), I32)
+                sent_ack = jnp.zeros((n,), I32)
             else:
                 # Approximate per-node split, exact totals — the filters
                 # of tpu_hash.make_step's scale branch on folded planes
@@ -594,8 +600,11 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             ids1 = state.probe_ids1
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)    # global target ids
-            act_g = lax.all_gather(act, AX, tiled=True)      # [N]
+            # act_g gathered per-branch: the profiling-only 'none' branch
+            # must structurally pay no [N] all_gather (its whole point is
+            # removing the counter-side ops from the measured tick).
             if cfg.count_probe_io:
+                act_g = lax.all_gather(act, AX, tiled=True)      # [N]
                 ack_send = v1 & act_g[tgt1]
                 recv_hist = jnp.zeros((n + 1,), I32).at[
                     jnp.where(v1, tgt1, n).reshape(-1)].add(
@@ -607,6 +616,12 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                     recv_hist, AX, scatter_dimension=0, tiled=True)
                 sent_ack = lax.psum_scatter(
                     ack_hist, AX, scatter_dimension=0, tiled=True)
+            elif cfg.probe_io_none:
+                # PROFILING ONLY (PROBE_IO: none): zero the probe-recv/
+                # ack-send counters, no per-target gather — probe sends /
+                # ack recvs still counted (tpu_hash.make_step's twin).
+                recv_probe = jnp.zeros((n_local,), I32)
+                sent_ack = jnp.zeros((n_local,), I32)
             else:
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs_sharded, _gathered_act,
@@ -615,6 +630,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                                            fail_time)
                 will_flush_g = lax.all_gather(
                     will_flush_l, AX, tiled=True)            # [N]
+                act_g = lax.all_gather(act, AX, tiled=True)      # [N]
                 # One packed random gather for both per-target bits
                 # (act + will_flush share tgt1).
                 packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]

@@ -621,11 +621,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             ids1 = state.probe_ids1
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
-            # act of every node this tick — the exact branch charges ack
-            # sends to targets, and BOTH branches need the act-of-target
-            # filter for exact totals (dead targets send no ack).
-            act_g = lax.all_gather(act, AX, tiled=True)     # [N]
+            # act of every node this tick — the counting branches need
+            # the act-of-target filter for exact totals (dead targets
+            # send no ack); gathered per-branch so the profiling-only
+            # 'none' branch structurally pays no [N] all_gather.
             if cfg.count_probe_io:
+                act_g = lax.all_gather(act, AX, tiled=True)     # [N]
                 ack_send = v1 & act_g[tgt1]
                 # Exact per-target attribution (tpu_hash.make_step's
                 # exact branch, distributed): local histograms over the
@@ -641,6 +642,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                     recv_hist, AX, scatter_dimension=0, tiled=True)
                 sent_ack = lax.psum_scatter(
                     ack_hist, AX, scatter_dimension=0, tiled=True)
+            elif cfg.probe_io_none:
+                # PROFILING ONLY (PROBE_IO: none): zero the probe-recv/
+                # ack-send counters, no per-target gather — probe sends /
+                # ack recvs still counted (tpu_hash.make_step's twin).
+                recv_probe = jnp.zeros_like(lrows)
+                sent_ack = jnp.zeros_like(lrows)
             else:
                 # Approximate per-node split, exact totals — the filters
                 # of tpu_hash.make_step's scale branch, distributed
@@ -649,6 +656,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                                            fail_time)
                 will_flush_g = lax.all_gather(
                     will_flush_l, AX, tiled=True)        # [N]
+                act_g = lax.all_gather(act, AX, tiled=True)     # [N]
                 # One packed random gather for both per-target bits
                 # (act + will_flush share tgt1) — the single-chip scale
                 # branch's packing, distributed.

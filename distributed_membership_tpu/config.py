@@ -137,6 +137,11 @@ class Params:
     # true row at ANY size; 'approx' charges probe traffic to the
     # prober's row (totals stay exact — tests/test_probe_io.py);
     # 'auto' picks exact up to tpu_hash.PROBE_IO_EXACT_MAX nodes.
+    # 'none' is PROFILING-ONLY: the probe-RECV and ack-SEND counters are
+    # zeroed (probe sends and ack receives are still counted — msgcount
+    # is asymmetric in this mode, not probe-free), which removes the
+    # counter-side per-target random gather from the tick — the bisect
+    # prices that gather on hardware with it (tpu_bisect.py 'nocount').
     PROBE_IO: str = "auto"
     # Enforce EmulNet's bounded send buffer (EN_BUFFSIZE, reference
     # ENBUFFSIZE=30000 with drop-on-full, EmulNet.cpp:92-94) on the
@@ -227,9 +232,10 @@ class Params:
             raise ValueError(
                 f"PRNG_IMPL must be threefry2x32|rbg|unsafe_rbg, got "
                 f"{self.PRNG_IMPL!r}")
-        if self.PROBE_IO not in ("auto", "exact", "approx"):
+        if self.PROBE_IO not in ("auto", "exact", "approx", "none"):
             raise ValueError(
-                f"PROBE_IO must be auto|exact|approx, got {self.PROBE_IO!r}")
+                f"PROBE_IO must be auto|exact|approx|none, "
+                f"got {self.PROBE_IO!r}")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(

@@ -138,13 +138,14 @@ def run_variants(n: int, s: int, ticks: int, tags) -> list:
     from distributed_membership_tpu.config import Params
     from distributed_membership_tpu.runtime.failures import make_plan
 
-    def point(tag, fanout, g, probes):
+    def point(tag, fanout, g, probes, probe_io="auto"):
         params = Params.from_text(
             f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             f"MSG_DROP_PROB: 0\nVIEW_SIZE: {s}\nGOSSIP_LEN: {g}\n"
             f"PROBES: {probes}\nFANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\n"
             f"TOTAL_TIME: {ticks}\nFAIL_TIME: {ticks // 2}\n"
             "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+            f"PROBE_IO: {probe_io}\n"
             # Pinned OFF, not auto: once the correctness arms bank, auto
             # would resolve FOLDED/FUSED on and this would bisect a
             # different program than the 1M_s16 baseline under study.
@@ -172,6 +173,10 @@ def run_variants(n: int, s: int, ticks: int, tags) -> list:
         # Probes OFF entirely: kills the ack-gather pipeline (the [N, P]
         # random gathers the HLO census flagged), not just its width.
         "noprobe": (3, g0, 0),
+        # Probes ON, counters OFF (PROBE_IO: none): isolates the
+        # counter-side gather from the ack-value gather — together with
+        # 'noprobe' this decomposes the pipeline's two random gathers.
+        "nocount": (3, g0, p0, "none"),
     }
     return [point(tag, *specs[tag]) for tag in tags]
 
@@ -183,7 +188,7 @@ PHASES = {
     "micro": None,                       # op microbenches only
     "cfg_a": ("full", "fanout1"),        # baseline + gossip slope
     "cfg_b": ("nothin", "probes8"),      # thinning draw + probe width
-    "cfg_c": ("noprobe",),               # ack-gather pipeline removal
+    "cfg_c": ("noprobe", "nocount"),     # gather-pipeline decomposition
 }
 
 

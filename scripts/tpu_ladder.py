@@ -76,7 +76,7 @@ BISECT_RUNGS = [
     ("bisect_micro_1M_s16", 1 << 20, 16, 30, "micro", 700),
     ("bisect_cfga_1M_s16", 1 << 20, 16, 30, "cfg_a", 700),
     ("bisect_cfgb_1M_s16", 1 << 20, 16, 30, "cfg_b", 700),
-    ("bisect_cfgc_1M_s16", 1 << 20, 16, 30, "cfg_c", 500),
+    ("bisect_cfgc_1M_s16", 1 << 20, 16, 30, "cfg_c", 900),
 ]
 # Derived, not hand-copied: a new phase rung added above must get the
 # same no-Pallas gating exemption without a second edit site.

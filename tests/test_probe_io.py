@@ -90,3 +90,33 @@ def test_pack_probe_bits_roundtrip():
                                   np.asarray(wf))
     np.testing.assert_array_equal(np.asarray(_gathered_act(packed)),
                                   np.asarray(act))
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("backend,extra", [
+    ("tpu_hash", ""),
+    ("tpu_hash_sharded", ""),
+    # Folded rows: P must divide 128 and EVENT_MODE agg (folded layout
+    # support envelope — tpu_hash_folded.folded_supported); TREMOVE
+    # re-sized for the wider P=2 probe cycle.
+    ("tpu_hash",
+     "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n"),
+    ("tpu_hash_sharded",
+     "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n"),
+], ids=["hash", "sharded", "folded", "folded_sharded"])
+def test_probe_io_none_profiling_mode(backend, extra):
+    """PROBE_IO: none (profiling-only) must not perturb the protocol —
+    same dbg events as approx on the same seed — only the probe-recv /
+    ack-send counters disappear (strictly fewer counted messages).
+    Covers all four step twins (the zero shapes differ per twin)."""
+    a = Params.from_text(CONF + extra
+                         + f"BACKEND: {backend}\nPROBE_IO: approx\n")
+    z = Params.from_text(CONF + extra
+                         + f"BACKEND: {backend}\nPROBE_IO: none\n")
+    ra = get_backend(backend)(a, seed=5)
+    rz = get_backend(backend)(z, seed=5)
+    assert ra.log.dbg_text() == rz.log.dbg_text()
+    sent_a, sent_z = np.asarray(ra.sent), np.asarray(rz.sent)
+    recv_a, recv_z = np.asarray(ra.recv), np.asarray(rz.recv)
+    assert sent_z.sum() < sent_a.sum()     # ack sends uncounted
+    assert recv_z.sum() < recv_a.sum()     # probe recvs uncounted
