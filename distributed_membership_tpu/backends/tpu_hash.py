@@ -203,6 +203,8 @@ class HashState(NamedTuple):
     #                          [1,1] zeros otherwise), 0 = none
     probe_ids2: jax.Array    # [N, P] u32 ids probed two ticks ago (ring)
     act_prev: jax.Array      # [N] bool act mask of the previous tick (ring)
+    wf_prev: jax.Array       # [N] bool will_flush of the previous tick
+    #                          (probe_io_lag only; [1] zeros otherwise)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +231,12 @@ class HashConfig:
     #                              the probe-recv/ack-send counters,
     #                              removing their per-target random
     #                              gather from the tick
+    probe_io_lag: bool = False   # PROBE_IO: approx_lag — one [N, 2]-wide
+    #                              per-target gather per tick: counter
+    #                              bits ride the ack-value gather, with
+    #                              attribution delayed one tick (run
+    #                              totals stay exact; single-chip ring
+    #                              natural layout only)
     fused_receive: bool = False  # ring receive via the Pallas one-pass
     #                              kernel (ops/fused_receive) instead of
     #                              the jnp expression of the same math
@@ -327,6 +335,7 @@ def init_state(cfg: HashConfig) -> HashState:
         probe_ids1=jnp.zeros(probe_shape, U32),
         probe_ids2=jnp.zeros(probe_shape, U32),
         act_prev=jnp.zeros((n,) if ring else (1,), bool),
+        wf_prev=jnp.zeros((n,) if cfg.probe_io_lag else (1,), bool),
     )
 
 
@@ -484,7 +493,18 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 ids2 = state.probe_ids2
                 id2 = jnp.clip(ids2.astype(I32) - 1, 0)
                 vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-                hb_ack = vec[id2]                          # [N, P] gather
+                if cfg.probe_io_lag:
+                    # approx_lag: the counter filter bits (t-1 snapshots,
+                    # _pack_probe_bits) ride the ack-value gather — ONE
+                    # [N, 2]-wide per-target random gather per tick.
+                    tbl2 = jnp.stack(
+                        [vec, _pack_probe_bits(state.wf_prev,
+                                               state.act_prev)], axis=1)
+                    g2 = tbl2[id2]                  # [N, P, 2] one gather
+                    hb_ack = g2[..., 0]
+                    lag_bits = g2[..., 1]
+                else:
+                    hb_ack = vec[id2]                      # [N, P] gather
                 valid2 = (ids2 > 0) & (hb_ack > 0)
                 # Probe-leg drops applied at issue time (probe block below,
                 # one coin shared by both redundant copies, as in scatter
@@ -808,6 +828,21 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # the tick (probe sends / ack recvs are still counted).
                 recv_probe = jnp.zeros((n,), I32)
                 sent_ack = jnp.zeros((n,), I32)
+            elif cfg.probe_io_lag:
+                # approx_lag: counts for arrivals at t-1, from the bits
+                # that rode the ack gather (lag_bits — t-1 snapshots of
+                # will_flush/act for the ids probed at t-2).  The recv
+                # counts inject DIRECTLY into this tick's recv stream
+                # (recv_direct, not pending_recv): exact mode's arrival
+                # at tau flushes into the stream at tau+1, which is
+                # exactly now — per-tick recv totals match exact, and
+                # the stranded-final-arrival behavior matches too (see
+                # run_scan's lag epilogue for the ack-send tail).
+                v2 = ids2 > 0
+                recv_probe = jnp.zeros((n,), I32)
+                recv_direct = (v2 & _gathered_flush(lag_bits)).sum(
+                    1, dtype=I32) * p_red
+                sent_ack = (v2 & _gathered_act(lag_bits)).sum(1, dtype=I32)
             else:
                 # Scale mode: same global volume, attributed to the
                 # prober's row (per-node probe recv/ack-send counters
@@ -827,6 +862,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 sent_ack = (v1 & _gathered_act(packed_g)).sum(1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
+            if cfg.probe_io_lag:
+                recv_tick = recv_tick + recv_direct
         elif cfg.probes > 0:
             ptr = jax.lax.rem(t * cfg.probes, s)
             off = jax.lax.rem(jnp.arange(s, dtype=I32) - ptr + 2 * s, s)
@@ -899,10 +936,12 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                                    (rm_ids != EMPTY).sum(dtype=I32),
                                    sent_tick.sum(dtype=I32),
                                    recv_tick.sum(dtype=I32))
+        wf_prev = (_will_flush(recv_mask, fail_mask, t, fail_time)
+                   if cfg.probe_io_lag else state.wf_prev)
         new_state = HashState(view, view_ts, started, in_group, failed,
                               self_hb, mail, amail, pmail, joinreq_infl,
                               joinrep_infl, pending_recv, agg,
-                              probe_ids1, probe_ids2, act_prev)
+                              probe_ids1, probe_ids2, act_prev, wf_prev)
         return new_state, out
 
     return step
@@ -921,6 +960,14 @@ def make_config(params: Params, collect_events: bool = True,
     qp = n if n <= 1024 else max(128, 32 * params.PROBES)
     seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
     exchange = params.resolved_exchange()
+    if params.PROBE_IO == "approx_lag" and exchange != "ring":
+        # Loud-rejection policy of the off-path layouts (the sharded and
+        # folded guards): on scatter the lag counting branch is
+        # unreachable, so silently accepting the knob would hand back
+        # exact counters while claiming the single-gather pipeline.
+        raise ValueError(
+            "PROBE_IO approx_lag requires EXCHANGE ring (scatter keeps "
+            "exact slot-addressed counters)")
     # The scatter-free aggregate path needs the failed-id set statically
     # and does F elementwise passes per tick (observability/aggregates.py).
     fast_agg = (not collect_events and exchange == "ring"
@@ -1070,6 +1117,7 @@ def make_config(params: Params, collect_events: bool = True,
                         if params.PROBE_IO == "auto"
                         else params.PROBE_IO == "exact"),
         probe_io_none=params.PROBE_IO == "none",
+        probe_io_lag=params.PROBE_IO == "approx_lag",
         fused_receive=fused, fused_gossip=fused_g, folded=folded,
         send_budget=send_budget)
 
@@ -1080,6 +1128,11 @@ _RUNNER_CACHE: dict = {}
 def _get_runner(cfg: HashConfig, warm: bool):
     cache_key = (cfg, warm)
     if cache_key not in _RUNNER_CACHE:
+        if cfg.folded and cfg.probe_io_lag:
+            raise ValueError(
+                "PROBE_IO approx_lag requires the natural layout "
+                "(FOLDED: 0) — the folded step keeps the two-gather "
+                "attribution")
         if cfg.folded:
             from distributed_membership_tpu.backends.tpu_hash_folded import (
                 init_state_warm_folded, make_folded_step)
@@ -1099,7 +1152,29 @@ def _get_runner(cfg: HashConfig, warm: bool):
                 return step(state, (t, k, start_ticks, fail_mask,
                                     fail_time, drop_lo, drop_hi))
 
-            return jax.lax.scan(body, state0, (ticks, keys))
+            final, ys = jax.lax.scan(body, state0, (ticks, keys))
+            if cfg.probe_io_lag and cfg.probes > 0:
+                # Lag tail, ON-DEVICE inside the same jit (one [N, P]
+                # gather per RUN — amortized to nothing; a host epilogue
+                # here would bias any timed caller and be skipped by
+                # direct-runner drivers): the delayed counters cover ack
+                # sends for arrivals 0..T-2; the final tick's (probes
+                # issued T-2 arriving T-1, still in the final
+                # probe_ids2/act_prev snapshots) are added so run totals
+                # equal exact mode's.  Recv needs no tail: exact mode's
+                # final-tick arrival counts strand in pending_recv and
+                # never reach the stream either.
+                ids2f = final.probe_ids2
+                corr = ((ids2f > 0) & final.act_prev[
+                    jnp.clip(ids2f.astype(I32) - 1, 0)]).sum(1, dtype=I32)
+                if cfg.collect_events:
+                    ys = ys._replace(sent=ys.sent.at[-1].add(corr))
+                else:
+                    final = final._replace(agg=final.agg._replace(
+                        sent_total=final.agg.sent_total + corr))
+                    ys = ys._replace(sent=ys.sent.at[-1].add(
+                        corr.sum(dtype=I32)))
+            return final, ys
 
         _RUNNER_CACHE[cache_key] = jax.jit(run)
     return _RUNNER_CACHE[cache_key]

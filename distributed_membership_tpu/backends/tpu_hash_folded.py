@@ -404,7 +404,8 @@ def make_folded_step(cfg):
                               failed, self_hb, mail, state.amail,
                               state.pmail, state.joinreq_infl,
                               state.joinrep_infl, pending_recv, agg,
-                              probe_ids1, probe_ids2, act_prev)
+                              probe_ids1, probe_ids2, act_prev,
+                              state.wf_prev)
         return new_state, out
 
     return step
@@ -720,4 +721,5 @@ def init_state_warm_folded(cfg, key: jax.Array):
         probe_ids1=jnp.zeros(probe_shape, U32),
         probe_ids2=jnp.zeros(probe_shape, U32),
         act_prev=jnp.zeros((cfg.n,), bool),
+        wf_prev=jnp.zeros((1,), bool),   # approx_lag is natural-layout only
     )

@@ -1167,6 +1167,10 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     n_local = n // d
     fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
     cfg = make_config(params, collect_events, fail_ids=fail_ids)
+    if cfg.probe_io_lag:
+        raise ValueError(
+            "PROBE_IO approx_lag is single-chip tpu_hash only (the "
+            "sharded twins keep the two-gather attribution)")
 
     # Per-shard structural re-validation: make_config checked the GLOBAL
     # shapes; the folded planes / kernel row blocks cover the LOCAL rows

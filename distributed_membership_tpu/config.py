@@ -142,6 +142,11 @@ class Params:
     # is asymmetric in this mode, not probe-free), which removes the
     # counter-side per-target random gather from the tick — the bisect
     # prices that gather on hardware with it (tpu_bisect.py 'nocount').
+    # 'approx_lag' keeps the counters but rides them on the ack-value
+    # gather (ONE [N, 2]-wide per-target gather per tick instead of two):
+    # probe-recv/ack-send attribution is delayed one tick, run TOTALS
+    # stay equal to exact (tests/test_probe_io.py), per-tick ack-send
+    # columns shift by one.  Single-chip ring, natural layout only.
     PROBE_IO: str = "auto"
     # Enforce EmulNet's bounded send buffer (EN_BUFFSIZE, reference
     # ENBUFFSIZE=30000 with drop-on-full, EmulNet.cpp:92-94) on the
@@ -232,9 +237,10 @@ class Params:
             raise ValueError(
                 f"PRNG_IMPL must be threefry2x32|rbg|unsafe_rbg, got "
                 f"{self.PRNG_IMPL!r}")
-        if self.PROBE_IO not in ("auto", "exact", "approx", "none"):
+        if self.PROBE_IO not in ("auto", "exact", "approx", "approx_lag",
+                                 "none"):
             raise ValueError(
-                f"PROBE_IO must be auto|exact|approx|none, "
+                f"PROBE_IO must be auto|exact|approx|approx_lag|none, "
                 f"got {self.PROBE_IO!r}")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):

@@ -85,6 +85,13 @@ def run_sweep(spec: SweepSpec = SweepSpec()) -> list[dict]:
     """Execute the grid; returns one record per (fanout, drop, seed)."""
     params = spec.to_params()
     cfg = make_config(params, collect_events=False)
+    if cfg.probe_io_lag:
+        # This driver runs make_step + its own scan, bypassing
+        # _get_runner's on-device lag tail — totals would silently lose
+        # the final tick's ack sends (the documented approx_lag
+        # contract).  Reject rather than drift.
+        raise ValueError("PROBE_IO approx_lag is not supported by the "
+                         "sweep driver (no lag tail in its scan)")
     # The crashed node is a *traced* per-lane value here, so the sweep needs
     # the AggStats path (per-id accumulators indexable by a traced id) —
     # the static-failed-id FastAgg fast path cannot apply.

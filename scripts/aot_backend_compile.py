@@ -165,6 +165,16 @@ def main() -> int:
         matched += 1
         attempt(name, lambda: backend_compile(
             _conf(n, s, fr, fg, drops, folded), sharding))
+    if not args.variant or args.variant == "approx_lag":
+        matched += 1
+
+        def _lag_params():
+            p = _conf(4096, 128, False, False, False, False)
+            p.PROBE_IO = "approx_lag"
+            p.validate()
+            return p
+        attempt("approx_lag",
+                lambda: backend_compile(_lag_params(), sharding))
     for (name, n, s, fr, fg, drops, folded, dims) in SHARDED_VARIANTS:
         if args.variant and name != args.variant:
             continue

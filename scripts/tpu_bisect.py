@@ -177,6 +177,10 @@ def run_variants(n: int, s: int, ticks: int, tags) -> list:
         # counter-side gather from the ack-value gather — together with
         # 'noprobe' this decomposes the pipeline's two random gathers.
         "nocount": (3, g0, p0, "none"),
+        # The production single-gather pipeline (counter bits ride the
+        # ack gather, attribution lagged one tick): the candidate
+        # default if it approaches 'nocount'.
+        "lag": (3, g0, p0, "approx_lag"),
     }
     return [point(tag, *specs[tag]) for tag in tags]
 
@@ -188,7 +192,7 @@ PHASES = {
     "micro": None,                       # op microbenches only
     "cfg_a": ("full", "fanout1"),        # baseline + gossip slope
     "cfg_b": ("nothin", "probes8"),      # thinning draw + probe width
-    "cfg_c": ("noprobe", "nocount"),     # gather-pipeline decomposition
+    "cfg_c": ("noprobe", "nocount", "lag"),  # gather-pipeline decomposition
 }
 
 

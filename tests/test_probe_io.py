@@ -120,3 +120,40 @@ def test_probe_io_none_profiling_mode(backend, extra):
     recv_a, recv_z = np.asarray(ra.recv), np.asarray(rz.recv)
     assert sent_z.sum() < sent_a.sum()     # ack sends uncounted
     assert recv_z.sum() < recv_a.sum()     # probe recvs uncounted
+
+
+@pytest.mark.quick
+def test_probe_io_approx_lag_totals_and_protocol():
+    """PROBE_IO: approx_lag rides the counter bits on the ack-value
+    gather (one per-target gather per tick).  Contract: protocol
+    trajectory identical to approx; RUN totals (sent and recv) exactly
+    equal exact mode's (the lag epilogue pays the final tick's ack
+    sends); per-tick recv totals also match exact (direct stream
+    injection lands at arrival+1, like exact's pending flush); per-tick
+    sent columns shift by one for the ack share (the documented cost)."""
+    s_ex, r_ex = _run("tpu_hash", "exact")
+    s_lag, r_lag = _run("tpu_hash", "approx_lag")
+    assert s_ex.sum() == s_lag.sum()
+    assert r_ex.sum() == r_lag.sum()
+    np.testing.assert_array_equal(r_ex.sum(0), r_lag.sum(0))
+    assert not np.array_equal(s_ex.sum(0), s_lag.sum(0))
+
+    a = Params.from_text(CONF + "BACKEND: tpu_hash\nPROBE_IO: approx\n")
+    z = Params.from_text(CONF + "BACKEND: tpu_hash\nPROBE_IO: approx_lag\n")
+    ra = get_backend("tpu_hash")(a, seed=5)
+    rz = get_backend("tpu_hash")(z, seed=5)
+    assert ra.log.dbg_text() == rz.log.dbg_text()
+
+
+def test_probe_io_approx_lag_rejected_off_path():
+    """approx_lag is single-chip natural-layout only: the sharded runner
+    and the folded layout must reject it loudly, not silently keep the
+    two-gather attribution."""
+    conf = (CONF.replace("EVENT_MODE: full", "EVENT_MODE: agg")
+            + "PROBE_IO: approx_lag\nPROBES: 2\nTFAIL: 16\nTREMOVE: 40\n")
+    p = Params.from_text(conf + "BACKEND: tpu_hash_sharded\n")
+    with pytest.raises(ValueError, match="single-chip"):
+        get_backend("tpu_hash_sharded")(p, seed=0)
+    p2 = Params.from_text(conf + "FOLDED: 1\nBACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="natural layout"):
+        get_backend("tpu_hash")(p2, seed=0)

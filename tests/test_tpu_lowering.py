@@ -113,3 +113,14 @@ def test_rbg_scan_lowers_for_tpu(impl):
     p.PRNG_IMPL = impl
     p.validate()
     _lower_for_tpu(p)
+
+
+@pytest.mark.quick
+def test_lag_scan_lowers_for_tpu():
+    """PROBE_IO approx_lag (the single-gather probe pipeline, a 1M_s16
+    ladder candidate) must lower for TPU like every other variant — its
+    [N, 2]-wide combined gather is a new gather geometry."""
+    p = _conf(4096, 128, False, False, False, False)
+    p.PROBE_IO = "approx_lag"
+    p.validate()
+    _lower_for_tpu(p)
