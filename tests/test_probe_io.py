@@ -157,3 +157,22 @@ def test_probe_io_approx_lag_rejected_off_path():
     p2 = Params.from_text(conf + "FOLDED: 1\nBACKEND: tpu_hash\n")
     with pytest.raises(ValueError, match="natural layout"):
         get_backend("tpu_hash")(p2, seed=0)
+
+
+def test_probe_io_approx_lag_totals_under_drops():
+    """The lag accounting must survive message drops: issue-time coins
+    filter what probe_ids record (so v2 one tick later sees exactly what
+    v1 saw), and counters draw no coins of their own — run totals must
+    still equal exact mode's across the drop window edges."""
+    conf = CONF.replace("DROP_MSG: 0", "DROP_MSG: 1").replace(
+        "MSG_DROP_PROB: 0", "MSG_DROP_PROB: 0.1")
+    def run(mode):
+        p = Params.from_text(conf + f"BACKEND: tpu_hash\n"
+                             f"PROBE_IO: {mode}\nTFAIL: 16\nTREMOVE: 48\n")
+        r = get_backend("tpu_hash")(p, seed=3)
+        return np.asarray(r.sent), np.asarray(r.recv)
+    s_ex, r_ex = run("exact")
+    s_lag, r_lag = run("approx_lag")
+    assert s_ex.sum() == s_lag.sum()
+    assert r_ex.sum() == r_lag.sum()
+    np.testing.assert_array_equal(r_ex.sum(0), r_lag.sum(0))
