@@ -94,3 +94,21 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture(scope="session")
 def testcases_dir():
     return REPO / "testcases"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables after each test module.
+
+    The full suite segfaulted (twice, reproducibly, ~90% in) inside
+    XLA:CPU's backend_compile after ~200 in-process tests: the process
+    accumulates every module's jitted executables and the sweep module's
+    large grid compile then dies in LLVM.  A fresh process compiles the
+    same grid fine, so the trigger is accumulation, not the program.
+    Cross-module cache reuse is near-zero (each module compiles its own
+    shapes), so dropping the caches at module teardown costs little and
+    bounds resident compiled code."""
+    yield
+    import jax
+
+    jax.clear_caches()
