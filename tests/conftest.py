@@ -32,7 +32,8 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 # The `quick` smoke tier (`pytest -m quick`, pytest.ini): one seed and the
 # smallest shape per backend/component, curated here centrally so the tier
-# stays under a minute as files grow.  Coverage rule: every backend's
+# stays around two minutes as files grow (it also carries the TPU
+# lowering + backend-compile gates now).  Coverage rule: every backend's
 # singlefailure grader pass, one unit test per custom op/kernel family,
 # and the pure-python components wholesale.  The full suite remains the
 # merge gate.
