@@ -107,6 +107,12 @@ LADDER = [
     # scheduling cliff rather than per-byte cost.
     ("65k_s16",          1 << 16,  16, 150, "off",    240),
     ("262k_s16",         1 << 18,  16, 100, "off",    300),
+    # Same-window s64 slope re-measure: the banked 262k (17:41Z) and
+    # 524k (01:17Z) rows came from different relay windows with
+    # IDENTICAL compiled programs (PERF.md compile diff) — adjacent
+    # rungs test whether the "superlinear break" survives one window.
+    ("262k_s64_w2",      1 << 18,  64,  60, "off",    420),
+    ("524k_s64_w2",      1 << 19,  64,  60, "off",    600),
     # PRNG_IMPL: rbg — same step, hardware-RNG key stream.  If the
     # bisect fingers the threefry draws, this is the measured win; if
     # not, it cheaply bounds the RNG share of the tick either way.
