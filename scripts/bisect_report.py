@@ -1,0 +1,107 @@
+"""Render the banked bisect evidence into an attribution table.
+
+Reads ``artifacts/TPU_PROFILE.json`` (or a file given with ``--profile``)
+and prints, for each platform that has bisect records:
+
+  * the config-bisection table — each variant's ms/tick, its delta vs
+    the ``full`` point, and the share of the full tick that knob owns;
+  * the op microbench table — ms and effective GB/s per op, plus each
+    op's naive share of the measured full tick;
+  * the derived verdict line: which suspect family (gossip rolls, RNG,
+    probe gathers, counters, residual) owns the largest share.
+
+Run it after the ladder banks ``bisect_*`` rungs:
+    python scripts/bisect_report.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(path: str) -> list:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def collect(recs: list, platform: str):
+    """Merge bisect phase records (latest per tag/op wins) per platform."""
+    variants: dict = {}
+    micro: dict = {}
+    for r in recs:
+        if r.get("platform") != platform:
+            continue
+        if not str(r.get("probe", "")).startswith("bisect"):
+            continue
+        for v in r.get("variants", []):
+            variants[v["tag"]] = v
+        micro.update(r.get("micro", {}))
+    return variants, micro
+
+
+def report(variants: dict, micro: dict) -> None:
+    full = variants.get("full", {}).get("ms_per_tick")
+    if variants:
+        print(f"{'variant':<10} {'ms/tick':>9} {'delta':>8} {'share':>7}")
+        for tag, v in sorted(variants.items(),
+                             key=lambda kv: kv[1]["ms_per_tick"]):
+            ms = v["ms_per_tick"]
+            if full and tag != "full":
+                d = full - ms
+                print(f"{tag:<10} {ms:>9.2f} {d:>+8.2f} {d / full:>6.1%}")
+            else:
+                print(f"{tag:<10} {ms:>9.2f} {'—':>8} {'—':>7}")
+    if micro:
+        print(f"\n{'op':<20} {'ms':>8} {'eff GB/s':>9}"
+              + (f" {'share of full':>14}" if full else ""))
+        for op, m in sorted(micro.items(), key=lambda kv: -kv[1]["ms"]):
+            line = f"{op:<20} {m['ms']:>8.3f} {m['eff_gbps']:>9.1f}"
+            if full:
+                line += f" {m['ms'] / full:>13.1%}"
+            print(line)
+    if full and variants:
+        shares = {tag: full - v["ms_per_tick"]
+                  for tag, v in variants.items() if tag != "full"}
+        if shares:
+            owner, delta = max(shares.items(), key=lambda kv: kv[1])
+            print(f"\nlargest single-knob share: {owner} "
+                  f"(removing it saves {delta:.1f} ms "
+                  f"= {delta / full:.1%} of the full tick)")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile",
+                    default=os.path.join(REPO, "artifacts",
+                                         "TPU_PROFILE.json"))
+    ap.add_argument("--platform", default=None,
+                    help="default: every platform with bisect records")
+    args = ap.parse_args()
+    try:
+        recs = load(args.profile)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read {args.profile}: {e}")
+        return 1
+    platforms = ([args.platform] if args.platform else
+                 sorted({r.get("platform") for r in recs
+                         if str(r.get("probe", "")).startswith("bisect")}))
+    if not platforms or platforms == [None]:
+        print("no bisect records banked yet "
+              "(run the bisect_* ladder rungs)")
+        return 1
+    for p in platforms:
+        variants, micro = collect(recs, p)
+        if not variants and not micro:
+            continue
+        print(f"=== platform: {p} ===")
+        report(variants, micro)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
