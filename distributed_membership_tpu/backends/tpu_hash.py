@@ -155,7 +155,7 @@ def _gathered_flush(packed):
 
 
 def _gathered_act(packed):
-    return packed >= 2      # values are 0..3; bit1 set iff >= 2
+    return (packed & 2) != 0    # bit test stays valid if the pack widens
 
 
 def _credit_orphan_recvs(per_prober, will_flush):
@@ -838,6 +838,15 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # exactly now — per-tick recv totals match exact, and
                 # the stranded-final-arrival behavior matches too (see
                 # run_scan's lag epilogue for the ack-send tail).
+                # Per-NODE split caveat: these recvs credit the
+                # prober's row with no _credit_orphan_recvs-style
+                # re-credit, so a row exact mode would never credit
+                # (e.g. a prober that failed between t-1 and now) can
+                # carry probe recvs here.  The approx branch below
+                # re-credits such orphans to a surviving row; the two
+                # approximate modes therefore differ in per-node
+                # attribution while agreeing on run and per-tick
+                # totals (pinned in tests/test_probe_io.py).
                 v2 = ids2 > 0
                 recv_probe = jnp.zeros((n,), I32)
                 recv_direct = (v2 & _gathered_flush(lag_bits)).sum(

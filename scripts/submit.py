@@ -10,10 +10,13 @@ the base64-encoded ``dbg.log`` as ``submission``/``submission_aux``
 environment has no egress — so the faithful part here is the PROTOCOL, not
 the transport:
 
-* default: run the chosen scenario on the chosen backend, build the exact
+* default: run the chosen scenario on the chosen backend, build the
   submission form payload, and write it to ``submission_<part>.json``
-  (plus the challenge-request payload) — everything a grading server would
-  receive, inspectable and re-playable;
+  (plus the challenge-request payload).  The challenge/state/
+  challenge_response fields are STAND-INS (a live submission redoes the
+  challenge leg and recomputes the response against the server's fresh
+  challenge); everything else is exactly what a grading server would
+  receive;
 * ``--endpoint http://…``: POST the same two requests (challenge, then
   submit) to a live self-hosted grader that speaks the Coursera form
   protocol.
@@ -101,7 +104,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not 1 <= args.part <= 3:
         ap.error("--part must be 1..3")
-    if args.password is None:
+    if args.password is None and args.endpoint:
+        # Only a live submission needs the credential; the offline
+        # artifact never uses it (challenge_response is a stand-in).
         args.password = getpass.getpass("One-time Password: ")
     part_sid = PART_IDS[args.part - 1]
     scenario = SCENARIO_BY_PART[args.part - 1]
@@ -138,9 +143,13 @@ def main(argv=None) -> int:
         # challenge) — the saved artifact documents WHAT would be sent,
         # it is not a replayable credential.
         ch, state = "offline-challenge", "offline-state"
+    # Only bind the password digest to a LIVE server challenge: an
+    # offline artifact carrying sha1(known-string + password) would be
+    # offline-crackable password material despite not being replayable.
+    ch_resp = (challenge_response(args.password, ch) if args.endpoint
+               else "not-computed-offline")
     payload = submission_payload(
-        args.email, part_sid, dbg_log,
-        challenge_response(args.password, ch), state)
+        args.email, part_sid, dbg_log, ch_resp, state)
 
     if args.endpoint:
         print("==", post("/assignment/submit", payload).strip())
