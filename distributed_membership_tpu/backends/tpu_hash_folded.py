@@ -72,8 +72,14 @@ def roll_nodes(x: jax.Array, r: jax.Array, f: int, s: int) -> jax.Array:
     a = jnp.roll(x, rq, axis=0)
     b = jnp.roll(a, 1, axis=0)
     lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
-    return jnp.where(lane < rr, jnp.roll(b, rr, axis=1),
-                     jnp.roll(a, rr, axis=1))
+    # Pre-select, then roll ONCE: result[l] = a[l-rr] for l >= rr and
+    # b[l-rr+128] for l < rr, i.e. roll(mix, rr) with mix = a on source
+    # lanes [0, 128-rr) and b on [128-rr, 128).  One dynamic lane roll
+    # instead of two — the dynamic misaligned lane rotate is the op
+    # class the 1M_s16 hardware pass flagged, and the folded step pays
+    # it every gossip shift (PERF.md round-4 anomalies).
+    mix = jnp.where(lane < LANES - rr, a, b)
+    return jnp.roll(mix, rr, axis=1)
 
 
 def roll_slots(x: jax.Array, c: jax.Array, s: int) -> jax.Array:
@@ -81,8 +87,13 @@ def roll_slots(x: jax.Array, c: jax.Array, s: int) -> jax.Array:
     a segment-wise lane roll, c in [0, s)."""
     lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
     pos = jax.lax.rem(lane, s)
-    return jnp.where(pos < c, jnp.roll(x, c - s, axis=1),
-                     jnp.roll(x, c, axis=1))
+    # Pre-select, then roll ONCE (same identity as roll_nodes): lanes
+    # whose post-roll position wraps inside the segment must source the
+    # NEXT segment's value — roll(x, -s) is a STATIC lane roll, so this
+    # form costs one static roll + select + one dynamic roll instead of
+    # two dynamic rolls + select.
+    mix = jnp.where(pos >= s - c, jnp.roll(x, -s, axis=1), x)
+    return jnp.roll(mix, c, axis=1)
 
 
 def _folded_receive(n, tfail, tremove, rep, rowsum, self_mask, node,

@@ -124,6 +124,25 @@ def run_micro(n: int, s: int) -> dict:
     rr = jnp.asarray(12345, jnp.int32)
     bank("roll_rows_dyn", _micro(
         lambda a, r: jnp.roll(a, r, axis=0), x, rr), 2 * plane_gb)
+    # Mitigation candidate A: restrict the per-tick shift to K static
+    # candidates and lax.switch over K static-roll branches — if XLA's
+    # dynamic-start lowering owns the 1M_s16 gap, this prices the fix
+    # (a protocol-RNG change: shifts drawn from a small static set).
+    shift_set = [(h * 2654435761) % n for h in range(1, 17)]
+    bank("roll_rows_switch16", _micro(
+        lambda a, i: jax.lax.switch(
+            i, [lambda a, r=r: jnp.roll(a, r, axis=0)
+                for r in shift_set], a),
+        x, jnp.asarray(7, jnp.int32)), 2 * plane_gb)
+    # The real per-shift gossip delivery op with TRACED shifts (row roll
+    # + column alignment + max) — the composite the step actually pays
+    # `fanout` times per tick; compare against gossip_shift (static).
+    sh1 = jnp.asarray(3, jnp.int32)
+    bank("gossip_shift_dyn",
+         _micro(lambda a, b, r, c: jnp.maximum(
+             b, jnp.roll(jnp.roll(a, r, axis=0), c, axis=1)),
+             x, y, rr, sh1),
+         4 * plane_gb)
     return out
 
 

@@ -21,7 +21,8 @@ from distributed_membership_tpu.backends.tpu_hash import (
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.runtime.failures import make_plan
 
-pytestmark = pytest.mark.quick
+# Quick tier carries only the cheap config-gate tests; the two ring-run
+# pairs below cost ~9 s and ~5 s and ride the full suite.
 
 
 def _ring_run(enforce, buffsize, n=256, s=16):
@@ -65,6 +66,7 @@ def test_nonbinding_budget_is_bit_exact():
     np.testing.assert_array_equal(np.asarray(e0.sent), np.asarray(e1.sent))
 
 
+@pytest.mark.quick
 def test_emul_buffer_pressure_drops_gossip():
     """The native oracle: shrinking EN_BUFFSIZE on the emul backend drops
     sends the same way (drop-on-full at ENsend, EmulNet.cpp:92-94)."""
@@ -82,6 +84,7 @@ def test_emul_buffer_pressure_drops_gossip():
     assert tight.sent.sum() < 0.7 * free.sent.sum()
 
 
+@pytest.mark.quick
 def test_enforce_buffsize_config_gates():
     base = ("MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
@@ -107,6 +110,7 @@ def test_enforce_buffsize_config_gates():
     assert cfg.send_budget == 30000 and cfg.fused_receive
 
 
+@pytest.mark.quick
 def test_enforce_buffsize_backend_and_join_gates():
     base = ("MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"

@@ -157,8 +157,7 @@ def test_sharded_auto_needs_sharded_families(tmp_path, monkeypatch):
     assert cfg16.folded and cfg16.fused_receive and cfg16.fused_gossip
 
 
-@pytest.mark.quick
-def test_sharded_auto_downgrades_on_local_shapes(tmp_path, monkeypatch):
+def test_sharded_auto_downgrades_on_local_shapes(tmp_path, monkeypatch):   # ~7 s: full-tier
     """Auto-enabled kernels that the PER-SHARD shapes cannot tile are
     silently downgraded by run_scan_sharded (auto never raises); the
     same violation with a pinned knob still raises."""
@@ -192,8 +191,7 @@ def test_sharded_auto_downgrades_on_local_shapes(tmp_path, monkeypatch):
                          collect_events=False)
 
 
-@pytest.mark.quick
-def test_folded_downgrade_never_strands_pinned_gossip(tmp_path, monkeypatch):
+def test_folded_downgrade_never_strands_pinned_gossip(tmp_path, monkeypatch):   # ~5 s: full-tier
     """Auto-FOLDED can downgrade per-shard (global N folds, L does not);
     a PINNED natural kernel must then be re-validated against the
     natural shapes — S=16 cannot tile the natural gossip kernel, so

@@ -43,8 +43,7 @@ def _mismatch(a, b) -> int:
                for x, y in zip(la, lb))
 
 
-@pytest.mark.quick
-def test_2d_torus_bit_exact_vs_flat():
+def test_2d_torus_bit_exact_vs_flat():   # ~21 s: full-tier
     p = _params()
     plan = make_plan(p, _pyrandom.Random("app:0"))
     s1, e1 = run_scan_sharded(p, plan, seed=7, mesh=make_mesh(8),
@@ -115,8 +114,7 @@ def test_2d_torus_cold_join_bit_exact_vs_flat():
     assert _mismatch(e1, e2) == 0
 
 
-@pytest.mark.quick
-def test_block_send_unit_every_shift():
+def test_block_send_unit_every_shift():   # ~8 s: full-tier
     """Unit contract of make_block_send on a 2x2x2 torus: for EVERY flat
     shift b, the decomposed per-axis route delivers shard s's payload to
     shard (s + b) mod 8 — i.e. it equals a flat roll of the

@@ -38,7 +38,7 @@ def _run(backend: str, probe_io: str):
 
 
 @pytest.mark.parametrize("backend", [
-    pytest.param("tpu_hash", marks=pytest.mark.quick),
+    "tpu_hash",   # ~26 s: full-tier (quick keeps the unit tests below)
     "tpu_hash_sharded",
 ])
 def test_totals_equal_split_differs(backend):
@@ -97,7 +97,7 @@ def test_pack_probe_bits_roundtrip():
 @pytest.mark.parametrize("backend,extra", [
     # Only the single-chip natural row rides the quick tier; the three
     # twins stay full-suite (they cost ~10 s each).
-    pytest.param("tpu_hash", "", marks=pytest.mark.quick),
+    ("tpu_hash", ""),   # ~10 s: full-tier
     ("tpu_hash_sharded", ""),
     # Folded rows: P must divide 128 and EVENT_MODE agg (folded layout
     # support envelope — tpu_hash_folded.folded_supported); TREMOVE
@@ -125,8 +125,7 @@ def test_probe_io_none_profiling_mode(backend, extra):
     assert recv_z.sum() < recv_a.sum()     # probe recvs uncounted
 
 
-@pytest.mark.quick
-def test_probe_io_approx_lag_totals_and_protocol():
+def test_probe_io_approx_lag_totals_and_protocol():   # ~11 s: full-tier
     """PROBE_IO: approx_lag rides the counter bits on the ack-value
     gather (one per-target gather per tick).  Contract: protocol
     trajectory identical to approx; RUN totals (sent and recv) exactly
