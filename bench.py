@@ -235,6 +235,8 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
                              r.get("folded"))
             if r.get("prng", "threefry2x32") != "threefry2x32":
                 mode += f"+prng:{r['prng']}"
+            if r.get("shift_set"):
+                mode += f"+sw{r['shift_set']}"
             rows.append({
                 "n": r["n"],
                 "mode": mode,

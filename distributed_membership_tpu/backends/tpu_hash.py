@@ -139,6 +139,39 @@ def _will_flush(recv_mask, fail_mask, t, fail_time):
     return recv_mask & ~(fail_mask & (t == fail_time))
 
 
+def deliver_shift(payload, r, n, s, cstride, idx):
+    """Deliver one circulant gossip shift: row roll by ``r`` + column
+    alignment (receiver slot = sender slot + delta*STRIDE with delta = r
+    for unwrapped receiver rows and r - N for wrapped ones; the two
+    coincide iff N*STRIDE % S == 0, saving a full [N, S] pass).
+
+    ``r`` may be a traced scalar (the default dynamic-roll path) or a
+    Python int — the SHIFT_SET lax.switch branches pass table constants
+    so every roll lowers to an aligned static copy.  Both callers share
+    this one definition, so the static path cannot drift from the
+    dynamic one (equality pinned in tests/test_shift_set.py)."""
+    static = isinstance(r, int)
+    rolled = jnp.roll(payload, r, axis=0)
+    s1 = ((r % s) * cstride % s if static
+          else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
+    r1 = jnp.roll(rolled, s1, axis=1)
+    if (n * STRIDE) % s == 0:
+        return r1
+    s2 = (((r - n) % s) * cstride % s if static
+          else jax.lax.rem(
+              jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s))
+    r2 = jnp.roll(rolled, s2, axis=1)
+    return jnp.where((idx >= r)[:, None], r1, r2)
+
+
+def shift_table(n: int, k: int) -> tuple:
+    """The static gossip-shift candidates for ``SHIFT_SET: K``:
+    golden-ratio-spread values in [1, n).  Entry 0 is shift 1, so the
+    union-of-K-circulants gossip graph always contains the full ring
+    cycle and stays connected regardless of n's factorization."""
+    return tuple(1 + (h * 2654435761) % (n - 1) for h in range(k))
+
+
 def _pack_probe_bits(will_flush, act):
     """Pack the two per-target filter bits of the approx probe-attribution
     branch into ONE i32 table (bit0 = will_flush, bit1 = act): ``act[tgt1]``
@@ -249,6 +282,11 @@ class HashConfig:
     #                              EmulNet's bounded buffer (EN_BUFFSIZE
     #                              drop-on-full, EmulNet.cpp:92-94);
     #                              0 = unbounded (documented deviation)
+    shift_set: int = 0           # K > 0: gossip shifts drawn from a
+    #                              static K-table, delivered via
+    #                              lax.switch over static-roll branches
+    #                              (the node-minor dynamic-roll
+    #                              mitigation — config.py SHIFT_SET)
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -615,7 +653,17 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 u = jax.random.uniform(k_entries, (n, s))
                 keep = fresh & ((u < p_keep[:, None]) | is_self_slot)
             keep = keep & act[:, None]
-            shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+            if cfg.shift_set:
+                # Static-table shifts (SHIFT_SET): same per-tick key
+                # stream, uniform over the K candidates; the delivery
+                # below switches over K static-roll branches.
+                table = shift_table(n, cfg.shift_set)
+                shift_idx = jax.random.randint(
+                    k_shifts, (k_max,), 0, cfg.shift_set)
+                shifts = jnp.asarray(table, I32)[shift_idx]
+            else:
+                shifts = jax.random.randint(k_shifts, (k_max,), 1,
+                                            max(n, 2))
             cstride = STRIDE % s
             sent_gossip = jnp.zeros((n,), I32)
             recv_add = jnp.zeros((n,), I32)
@@ -690,28 +738,28 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                         used = used + allowed.sum(dtype=I32)
                     r = shifts[j]
                     payload = jnp.where(m, view, U32(0))
-                    rolled = jnp.roll(payload, r, axis=0)
-                    # Column alignment: receiver slot = sender slot +
-                    # delta*STRIDE with delta = r for unwrapped receiver
-                    # rows (j >= r) and r - N for wrapped ones (j < r) —
-                    # two rolls selected per row.  They coincide iff
-                    # N*STRIDE % S == 0 — statically true whenever S
-                    # divides N (the usual scale config), saving a full
-                    # [N, S] pass per shift.
-                    s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
-                    r1 = jnp.roll(rolled, s1, axis=1)
-                    if (n * STRIDE) % s == 0:
-                        delivered = r1
-                    else:
-                        s2 = jax.lax.rem(
-                            jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
-                            * cstride, s)
-                        r2 = jnp.roll(rolled, s2, axis=1)
-                        delivered = jnp.where((idx >= r)[:, None], r1, r2)
-                    mail = jnp.maximum(mail, delivered)
                     cnt = m.sum(1, dtype=I32)
+                    if cfg.shift_set:
+                        # lax.switch over K static-roll branches: every
+                        # roll amount (row, column, wrapped column, AND
+                        # the recv-count roll) is a Python int, so XLA
+                        # lowers aligned copies instead of the dynamic
+                        # misaligned lane rotate the node-minor layout
+                        # forces (PERF.md 1M_s16).
+                        delivered, cnt_r = jax.lax.switch(
+                            shift_idx[j],
+                            [(lambda pl, c, rv=rv: (
+                                deliver_shift(pl, rv, n, s, cstride,
+                                              idx),
+                                jnp.roll(c, rv)))
+                             for rv in table], payload, cnt)
+                    else:
+                        delivered = deliver_shift(payload, r, n, s,
+                                                  cstride, idx)
+                        cnt_r = jnp.roll(cnt, r)
+                    mail = jnp.maximum(mail, delivered)
                     sent_gossip = sent_gossip + cnt
-                    recv_add = recv_add + jnp.roll(cnt, r)
+                    recv_add = recv_add + cnt_r
             sent_tick = sent_gossip + sent_req + sent_rep
             k_drop_s = k_drop
         else:
@@ -1011,8 +1059,13 @@ def make_config(params: Params, collect_events: bool = True,
         cleared = lambda *fams: families_clean(  # noqa: E731
             rec, *(pre + f for f in fams))
         if fold_knob == -1:
+            # SHIFT_SET is the NATURAL-layout roll experiment: auto must
+            # keep the conflicting fast paths off rather than resolve
+            # into the loud gates below ("auto never raises" — only
+            # explicitly pinned knobs conflict loudly).
             fold_knob = int(
-                eligible and exchange == "ring"
+                not params.SHIFT_SET
+                and eligible and exchange == "ring"
                 and params.JOIN_MODE == "warm" and fast_agg
                 and folded_supported(n, s, params.PROBES)
                 and send_budget_req == 0
@@ -1037,7 +1090,8 @@ def make_config(params: Params, collect_events: bool = True,
                 # ones the stacked variant — each auto-enables only on
                 # ITS OWN banked hardware family (fail closed).
                 fg_knob = int(
-                    eligible and exchange == "ring"
+                    not params.SHIFT_SET
+                    and eligible and exchange == "ring"
                     and gossip_fused_supported(n, s)
                     and send_budget_req == 0
                     and (cleared("fused_gossip", "fused_both")
@@ -1087,6 +1141,28 @@ def make_config(params: Params, collect_events: bool = True,
                 f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
                 f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s}); for "
                 f"S < 128 combine it with FOLDED")
+    if params.SHIFT_SET:
+        # Loud-rejection policy (same as PROBE_IO approx_lag): off-path
+        # layouts must not silently ignore the knob.
+        if exchange != "ring":
+            raise ValueError("SHIFT_SET requires the ring exchange")
+        if params.BACKEND != "tpu_hash":
+            raise ValueError(
+                "SHIFT_SET is single-chip tpu_hash only (the sharded "
+                "step's local rolls + collectives are a different "
+                "lowering; measure the mitigation single-chip first)")
+        if folded:
+            raise ValueError(
+                "SHIFT_SET is the NATURAL-layout roll mitigation; the "
+                "folded layout already rolls aligned 128-lane planes")
+        if fused_g:
+            raise ValueError(
+                "SHIFT_SET and FUSED_GOSSIP are incompatible (the "
+                "Pallas kernel rolls in VMEM — dynamic shifts are not "
+                "its bottleneck)")
+        if n <= params.SHIFT_SET:
+            raise ValueError(
+                f"SHIFT_SET ({params.SHIFT_SET}) must be < N ({n})")
     send_budget = send_budget_req
     if send_budget:
         if exchange != "ring":
@@ -1128,7 +1204,7 @@ def make_config(params: Params, collect_events: bool = True,
         probe_io_none=params.PROBE_IO == "none",
         probe_io_lag=params.PROBE_IO == "approx_lag",
         fused_receive=fused, fused_gossip=fused_g, folded=folded,
-        send_budget=send_budget)
+        send_budget=send_budget, shift_set=params.SHIFT_SET)
 
 
 _RUNNER_CACHE: dict = {}

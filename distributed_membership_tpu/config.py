@@ -166,6 +166,17 @@ class Params:
     # cross-run bit-stability for throughput).  The host/emul backends
     # use Python RNG and ignore this key.
     PRNG_IMPL: str = "threefry2x32"
+    # Natural-layout roll mitigation (round-5 experiment): draw each
+    # tick's gossip shifts from a STATIC K-entry table instead of
+    # uniform [1, N), and deliver via lax.switch over K static-roll
+    # branches.  At 1M_s16 XLA lays the [N, S] planes node-minor, which
+    # turns the dynamic row-roll into a misaligned dynamic LANE rotate —
+    # the suspected owner of the unattributed ~100 ms/tick (PERF.md);
+    # static shifts compile to aligned copies.  Protocol-visible change:
+    # the gossip graph becomes a union of K fixed circulants (table
+    # includes shift 1, so it stays connected; spread is golden-ratio).
+    # 0 = off (default).  Single-chip tpu_hash ring natural only.
+    SHIFT_SET: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -242,6 +253,11 @@ class Params:
             raise ValueError(
                 f"PROBE_IO must be auto|exact|approx|approx_lag|none, "
                 f"got {self.PROBE_IO!r}")
+        if self.SHIFT_SET and not 2 <= self.SHIFT_SET <= 64:
+            raise ValueError(
+                f"SHIFT_SET must be 0 (off) or 2..64 static shift "
+                f"candidates (got {self.SHIFT_SET}); each candidate adds "
+                f"a lax.switch branch to the compiled step")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(

@@ -38,7 +38,7 @@ def _example_scan_args(params, plan, ticks):
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                fanout: int = 3, cost: bool = False,
                fused_gossip: bool = False, folded: bool = False,
-               prng: str = "threefry2x32") -> dict:
+               prng: str = "threefry2x32", shift_set: int = 0) -> dict:
     import random as _pyrandom
 
     import jax
@@ -57,7 +57,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
-        f"PRNG_IMPL: {prng}\nBACKEND: tpu_hash\n")
+        f"PRNG_IMPL: {prng}\nSHIFT_SET: {shift_set}\n"
+        f"BACKEND: tpu_hash\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
 
     t0 = time.perf_counter()
@@ -113,7 +114,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
-        "prng": prng,
+        "prng": prng, "shift_set": shift_set,
         "fanout": cfg.fanout, "probes": cfg.probes,
         "platform": jax.default_backend(),
         # wall_seconds is a SECOND run on the warm jit cache; compile time
@@ -144,6 +145,9 @@ def main() -> int:
     ap.add_argument("--fused", default="off", choices=["off", "on", "both"])
     ap.add_argument("--fused-gossip", default="off", choices=["off", "on"])
     ap.add_argument("--folded", default="off", choices=["off", "on"])
+    ap.add_argument("--shift-set", type=int, default=0,
+                    help="SHIFT_SET: K static gossip-shift candidates "
+                         "(0 = off; the node-minor roll mitigation)")
     ap.add_argument("--prng", default="threefry2x32",
                     choices=["threefry2x32", "rbg", "unsafe_rbg"])
     ap.add_argument("--cost", action="store_true",
@@ -163,7 +167,8 @@ def main() -> int:
             rec = time_point(n, args.view, args.ticks, args.exchange,
                              fused, args.fanout, cost=args.cost,
                              fused_gossip=args.fused_gossip == "on",
-                             folded=args.folded == "on", prng=args.prng)
+                             folded=args.folded == "on", prng=args.prng,
+                             shift_set=args.shift_set)
             print(json.dumps(rec), flush=True)
     return 0
 

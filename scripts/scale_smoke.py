@@ -56,6 +56,9 @@ def main() -> int:
                     help="fail the run if any crashed id had fewer than "
                          "this many live trackers at the crash (detection-"
                          "quality floor, VERDICT r2 item 5)")
+    ap.add_argument("--shift-set", type=int, default=0,
+                    help="SHIFT_SET: K static gossip-shift candidates "
+                         "(0 = off)")
     ap.add_argument("--exchange", default="auto",
                     choices=["auto", "scatter", "ring"],
                     help="tpu_hash message-exchange lowering (auto picks "
@@ -138,7 +141,7 @@ def main() -> int:
         f"FANOUT: {args.fanout}\nTFAIL: {tfail}\nTREMOVE: {tremove}\n"
         f"TOTAL_TIME: {args.ticks}\nFAIL_TIME: {fail_time}\n"
         f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: {args.exchange}\n"
-        f"BACKEND: {args.backend}\n")
+        f"SHIFT_SET: {args.shift_set}\nBACKEND: {args.backend}\n")
 
     t0 = time.time()
     result = get_backend(args.backend)(params, seed=args.seed)
@@ -159,7 +162,7 @@ def main() -> int:
         "view_size": args.view, "gossip_len": args.gossip,
         "probes": args.probes, "fanout": args.fanout,
         "tfail": tfail, "tremove": tremove, "seed": args.seed,
-        "drop_prob": args.drop,
+        "drop_prob": args.drop, "shift_set": args.shift_set,
         "rack_size": args.rack_size, "rack_failures": args.rack_failures,
         "trackers_floor": args.trackers_floor, "trackers_floor_ok": floor_ok,
         "timing": "cold_compile_included",

@@ -128,7 +128,10 @@ def run_micro(n: int, s: int) -> dict:
     # candidates and lax.switch over K static-roll branches — if XLA's
     # dynamic-start lowering owns the 1M_s16 gap, this prices the fix
     # (a protocol-RNG change: shifts drawn from a small static set).
-    shift_set = [(h * 2654435761) % n for h in range(1, 17)]
+    # The table is the PRODUCTION one (tpu_hash.shift_table) so the
+    # micro benchmarks the same branch constants SHIFT_SET deploys.
+    from distributed_membership_tpu.backends.tpu_hash import shift_table
+    shift_set = list(shift_table(n, 16))
     bank("roll_rows_switch16", _micro(
         lambda a, i: jax.lax.switch(
             i, [lambda a, r=r: jnp.roll(a, r, axis=0)

@@ -107,6 +107,12 @@ LADDER = [
     # scheduling cliff rather than per-byte cost.
     ("65k_s16",          1 << 16,  16, 150, "off",    240),
     ("262k_s16",         1 << 18,  16, 100, "off",    300),
+    # SHIFT_SET: the natural-layout roll mitigation (lax.switch over 16
+    # static circulant shifts) at the cheap point and the north-star
+    # point — decides VERDICT weak #4 together with the micro's
+    # roll_rows_switch16 row.
+    ("65k_s16_sw16",     1 << 16,  16, 150, "sw16",   300),
+    ("1M_s16_sw16",      1 << 20,  16,  60, "sw16",   700),
     # Same-window s64 slope re-measure: the banked 262k (17:41Z) and
     # 524k (01:17Z) rows came from different relay windows with
     # IDENTICAL compiled programs (PERF.md compile diff) — adjacent
@@ -124,8 +130,14 @@ LADDER = [
     # the natural one, so give the compile room before calling it a
     # flake.
     ("65k_s16_folded",   1 << 16,  16, 150, "folded", 480),
+    # _v2: the round-5 pre-select/one-roll rewrite of roll_nodes /
+    # roll_slots (tpu_hash_folded) halves the dynamic lane rolls per
+    # gossip shift — these rungs measure the UNFUSED folded step after
+    # that rewrite (the non-v2 rows are the round-4 graph).
+    ("65k_s16_folded_v2", 1 << 16, 16, 150, "folded", 480),
     ("65k_s16_folded_fboth", 1 << 16, 16, 150, "folded_fboth", 480),
     ("1M_s16_folded",    1 << 20,  16,  60, "folded", 1200),
+    ("1M_s16_folded_v2", 1 << 20,  16,  60, "folded", 1200),
     ("1M_s16_folded_fboth", 1 << 20, 16, 60, "folded_fboth", 1200),
     ("524k_s64",         1 << 19,  64,  60, "off",    600),
     ("1M_s64_folded",    1 << 20,  64,  60, "folded", 900),
@@ -197,6 +209,7 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                else "off",
                "--folded",
                "on" if fused in ("folded", "folded_fboth") else "off",
+               "--shift-set", "16" if fused == "sw16" else "0",
                "--prng", "rbg" if fused == "rbg" else "threefry2x32"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -246,10 +259,13 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
-    if mode in ("off", "rbg") or mode in BISECT_PHASES or corr is None:
-        # 'rbg' swaps the key-stream impl on the plain jnp step — no
-        # Pallas kernel in the program, so no correctness family gates it
-        # (its protocol validity is pinned in tests/test_hash_backend.py).
+    if (mode in ("off", "rbg", "sw16") or mode in BISECT_PHASES
+            or corr is None):
+        # 'rbg' swaps the key-stream impl and 'sw16' the shift-draw
+        # distribution on the plain jnp step — no Pallas kernel in the
+        # program, so no correctness family gates them (protocol
+        # validity pinned in tests/test_hash_backend.py and
+        # tests/test_shift_set.py).
         return False
     if mode == "folded_fboth" and not _corr_covers_ladder(corr):
         # The verdict predates the folded_fused families: fail closed
