@@ -489,6 +489,30 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         else:
             ctrl_kept = jnp.ones((2, n), bool)
 
+        # EmulNet bounded-buffer model (ENFORCE_BUFFSIZE): one per-tick
+        # global send budget, consumed with drop-on-full per message
+        # (EmulNet.cpp:92-94) in this model's traversal order — join
+        # control (JOINREP then JOINREQ, node-minor), gossip shifts,
+        # the introducer seed burst, then probes; acks are exempt
+        # (README fidelity notes).  A budget-dropped JOINREQ/JOINREP is
+        # dropped FOREVER — the reference's joiner never retries
+        # (introduceSelfToGroup runs once, MP1Node.cpp:126-159), so a
+        # join storm over the cap permanently strands late joiners,
+        # which is exactly the regime the reference's 30k cap binds in.
+        track_budget = ring and cfg.send_budget > 0
+        if track_budget:
+            budget = jnp.asarray(cfg.send_budget, I32)
+            used = jnp.zeros((), I32)
+
+            def _budget_take(mask, used_now):
+                """Accept `mask`'s messages in traversal order until the
+                budget is spent; returns (kept, new_used)."""
+                flat = mask.reshape(-1)
+                csum = jnp.cumsum(flat.astype(I32)) + used_now
+                kept = flat & (csum <= budget)
+                return (kept.reshape(mask.shape),
+                        used_now + kept.sum(dtype=I32))
+
         # ---- pass 1: receive = elementwise admit-or-refresh combine ----
         # (make_admit: sticky admission.)  Acks apply first: their channel
         # is collision-free, and an occupant whose slot the gossip winner
@@ -567,6 +591,10 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         seeds = state.joinreq_infl & recv_mask[intro]
         joinreq_infl = state.joinreq_infl & ~recv_mask[intro]
         rep_ok = seeds & ctrl_kept[1]
+        if track_budget:
+            # A dropped JOINREP permanently strands the joiner (the
+            # request was consumed; the reference never re-replies).
+            rep_ok, used = _budget_take(rep_ok, used)
         joinrep_infl = joinrep_infl | rep_ok
         n_seeds = seeds.sum(dtype=I32)
         sent_rep = jnp.where(idx == intro,
@@ -580,6 +608,10 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         in_group = in_group.at[intro].set(in_group[intro] | boot)
 
         joiner_req = start_now & (idx != intro) & ctrl_kept[0]
+        if track_budget:
+            # A dropped JOINREQ is never retried (nodeStart runs once):
+            # the node stays started but never enters the group.
+            joiner_req, used = _budget_take(joiner_req, used)
         joinreq_infl = joinreq_infl | joiner_req
         if not ring:
             mail = _scatter_msgs(cfg, mail, jnp.full((n,), intro, I32), idx,
@@ -667,17 +699,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             cstride = STRIDE % s
             sent_gossip = jnp.zeros((n,), I32)
             recv_add = jnp.zeros((n,), I32)
-            # EmulNet bounded-buffer model (ENFORCE_BUFFSIZE): a per-tick
-            # global send budget consumed in the reference's traversal
-            # order — gossip shifts first, then probes, node-minor within
-            # each — with drop-on-full per message (EmulNet.cpp:92-94).
-            # Dropped sends never occupy the buffer.  Acks are exempt
-            # (README fidelity notes: the ring ack pipeline has no
-            # sender-side mailbox to budget).
-            track_budget = cfg.send_budget > 0
-            if track_budget:
-                budget = jnp.asarray(cfg.send_budget, I32)
-                used = jnp.zeros((), I32)
+            # Budget state (track_budget/budget/used/_budget_take) is
+            # initialized before the join section: consumption order is
+            # join control, gossip shifts, seed burst, probes.
             if cfg.fused_gossip and not use_drop and k_max > 0:
                 # One Pallas traversal for all shifts (ops/fused_gossip):
                 # mail is read+written once; sender rows arrive by
@@ -730,12 +754,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                             jax.random.fold_in(k_drop, j), p_drop, (n, s))
                             & drop_active)
                     if track_budget:
-                        cnt0 = m.sum(1, dtype=I32)
-                        starts = used + jnp.cumsum(cnt0) - cnt0
-                        allowed = jnp.clip(budget - starts, 0, cnt0)
-                        m = m & (jnp.cumsum(m.astype(I32), axis=1)
-                                 <= allowed[:, None])
-                        used = used + allowed.sum(dtype=I32)
+                        m, used = _budget_take(m, used)
                     r = shifts[j]
                     payload = jnp.where(m, view, U32(0))
                     cnt = m.sum(1, dtype=I32)
@@ -810,6 +829,11 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             dropped = jax.random.bernoulli(k_drop_s, p_drop,
                                            (seed_idx.shape[0], s))
             burst_valid = burst_valid & ~(dropped & drop_active)
+        if track_budget:
+            # One wire message per burst entry, after the gossip shifts
+            # in the consumption order (the reference's introducer sends
+            # its newNodes burst from the same sendMemberList phase).
+            burst_valid, used = _budget_take(burst_valid, used)
         mail = _scatter_msgs(
             cfg, mail, jnp.broadcast_to(seed_idx[:, None], burst_valid.shape),
             jnp.broadcast_to(cur_id[intro][None, :], burst_valid.shape),
@@ -1175,13 +1199,13 @@ def make_config(params: Params, collect_events: bool = True,
                 "ENFORCE_BUFFSIZE is not modeled on tpu_hash_sharded "
                 "(its scatter exchange bounds per-destination buckets "
                 "instead — bucket_capacity; README fidelity notes)")
-        if params.JOIN_MODE != "warm":
-            raise ValueError(
-                "ENFORCE_BUFFSIZE requires JOIN_MODE warm: cold-join "
-                "traffic (JOINREQ/JOINREP, introducer seed bursts) is "
-                "not budgeted, and join storms are exactly where the "
-                "reference's cap binds — use the emul backends for "
-                "capped cold joins")
+        # Cold joins (JOIN_MODE staggered/batch) ARE budgeted since
+        # round 5: JOINREQ/JOINREP and the introducer seed burst consume
+        # the same per-tick budget (join control first, then gossip,
+        # burst, probes), with drop-forever semantics matching the
+        # reference's retry-free join handshake — join storms over the
+        # cap permanently strand late joiners, the regime where the
+        # reference's 30k cap binds (EmulNet.cpp:87-94).
         if folded:
             raise ValueError(
                 "ENFORCE_BUFFSIZE is not modeled on the FOLDED layout")

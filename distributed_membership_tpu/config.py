@@ -151,8 +151,12 @@ class Params:
     # Enforce EmulNet's bounded send buffer (EN_BUFFSIZE, reference
     # ENBUFFSIZE=30000 with drop-on-full, EmulNet.cpp:92-94) on the
     # tpu_hash ring exchange as a per-tick global send budget: sends are
-    # accepted in the reference's traversal order (gossip shifts, then
-    # probes; node-minor) until the budget is spent, the rest drop.  The
+    # accepted in traversal order — join control (JOINREP then JOINREQ),
+    # gossip shifts, the introducer seed burst, then probes; node-minor
+    # within each — until the budget is spent, the rest drop.  A
+    # budget-dropped JOINREQ/JOINREP strands the joiner FOREVER (the
+    # reference's handshake never retries, MP1Node.cpp:126-159), so
+    # cold-join storms over the cap permanently lose late joiners.  The
     # emul backends always enforce the cap exactly; the jitted paths
     # default to unbounded — see README "Network-semantics fidelity
     # notes" for the deviation list.

@@ -117,12 +117,64 @@ def test_enforce_buffsize_backend_and_join_gates():
             "TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
             "EVENT_MODE: agg\nENFORCE_BUFFSIZE: 1\nEXCHANGE: ring\n")
     # Silently-uncapped combinations must raise, not no-op: the sharded
-    # step has no budget plumbing, and cold-join storms are unbudgeted.
+    # step has no budget plumbing.
     with pytest.raises(ValueError, match="tpu_hash_sharded"):
         make_config(Params.from_text(
             base + "JOIN_MODE: warm\nBACKEND: tpu_hash_sharded\n"),
             collect_events=False)
-    with pytest.raises(ValueError, match="JOIN_MODE warm"):
-        make_config(Params.from_text(
-            base + "JOIN_MODE: batch\nBACKEND: tpu_hash\n"),
-            collect_events=False)
+    # Cold joins are budgeted since round 5: batch/staggered compose.
+    cfg = make_config(Params.from_text(
+        base + "JOIN_MODE: batch\nBACKEND: tpu_hash\n"),
+        collect_events=False)
+    assert cfg.send_budget == 30000
+
+
+def _cold_run(join_mode, enforce, buffsize, n=1024, s=16, ticks=40):
+    p = Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {s // 2}\nPROBES: 2\nFANOUT: 3\n"
+        f"TFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: {ticks}\nFAIL_TIME: -1\n"
+        f"JOIN_MODE: {join_mode}\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+        f"ENFORCE_BUFFSIZE: {enforce}\nEN_BUFFSIZE: {buffsize}\n"
+        "BACKEND: tpu_hash\n")
+    plan = make_plan(p, random.Random("app:0"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return run_scan(p, plan, seed=0, collect_events=False)
+
+
+def test_cold_join_storm_budget_strands_late_joiners():
+    """JOIN_MODE batch fires N-1 JOINREQs in one tick; a binding budget
+    must strand the overflow FOREVER (the reference's joiner never
+    retries, MP1Node.cpp:126-159) while a generous one admits all."""
+    budget = 200
+    fs_free, _ = _cold_run("batch", 0, budget)
+    fs_cap, _ = _cold_run("batch", 1, budget)
+    n_free = int(np.asarray(fs_free.in_group).sum())
+    n_cap = int(np.asarray(fs_cap.in_group).sum())
+    assert n_free == 1024                      # uncapped: everyone joins
+    # Capped: the first-tick JOINREQ wave alone is 1023 > budget; joiners
+    # admitted are bounded by the per-tick budget and must stay stranded
+    # through the run's end (no retry path exists to admit them later).
+    assert 0 < n_cap <= budget + 1
+    # Stranded nodes never became active participants: act gates on
+    # in_group, so their self-heartbeat never advances off zero (a
+    # regression that un-gates act would trip this even with in_group
+    # still counted correctly above).
+    in_group = np.asarray(fs_cap.in_group)
+    self_hb = np.asarray(fs_cap.self_hb)
+    assert (self_hb[~in_group] == 0).all()
+    assert (self_hb[in_group] > 0).all()
+
+
+def test_nonbinding_budget_is_bit_exact_cold_join():
+    """A budget that never binds must leave the cold-join trajectory
+    bit-identical (same contract the warm-path twin pins above)."""
+    f0, e0 = _cold_run("staggered", 0, 10 ** 7, n=256, ticks=80)
+    f1, e1 = _cold_run("staggered", 1, 10 ** 7, n=256, ticks=80)
+    for name in ("view", "view_ts", "mail", "in_group", "started",
+                 "self_hb", "pending_recv"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    np.testing.assert_array_equal(np.asarray(e0.sent), np.asarray(e1.sent))
