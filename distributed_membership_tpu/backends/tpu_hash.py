@@ -164,6 +164,28 @@ def deliver_shift(payload, r, n, s, cstride, idx):
     return jnp.where((idx >= r)[:, None], r1, r2)
 
 
+def ptr_switch(ptr, step: int, s: int, fn, *operands, max_branches: int = 16):
+    """Dispatch a deterministic slot-pointer roll over its STATIC value set.
+
+    The probe/ack pointers advance by ``step`` slots per tick mod ``s``,
+    so they only ever take the multiples of ``d = gcd(step, s)`` — at
+    most ``s // d`` distinct values.  When that set is small, a
+    ``lax.switch`` over static branches replaces the full-plane dynamic
+    lane roll XLA would otherwise emit (the op class flagged at 1M_s16,
+    PERF.md); each branch calls ``fn`` with a Python-int pointer, which
+    lowers to aligned static copies/slices.  Falls back to
+    ``fn(ptr)`` (traced) when the value set is too large.  Bit-exact by
+    construction: both paths evaluate the same ``fn``."""
+    import math
+
+    d = math.gcd(step % s or s, s)
+    if s // d > max_branches:
+        return fn(ptr, *operands)
+    return jax.lax.switch(
+        ptr // d, [(lambda *ops, o=o: fn(o, *ops))
+                   for o in range(0, s, d)], *operands)
+
+
 def shift_table(n: int, k: int) -> tuple:
     """The static gossip-shift candidates for ``SHIFT_SET: K``:
     golden-ratio-spread values in [1, n).  Entry 0 is shift 1, so the
@@ -505,13 +527,32 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             used = jnp.zeros((), I32)
 
             def _budget_take(mask, used_now):
-                """Accept `mask`'s messages in traversal order until the
-                budget is spent; returns (kept, new_used)."""
-                flat = mask.reshape(-1)
-                csum = jnp.cumsum(flat.astype(I32)) + used_now
-                kept = flat & (csum <= budget)
-                return (kept.reshape(mask.shape),
-                        used_now + kept.sum(dtype=I32))
+                """Accept `mask`'s messages in traversal order (row-major)
+                until the budget is spent; returns (kept, new_used).
+
+                2-D masks use the decomposed row-count/clip form — bit-
+                identical to the flat cumsum but the scan dimensions stay
+                N and S instead of one N*S-element scan (the gossip loop
+                calls this per shift on [N, S] at the 1M scale).
+
+                Monotonicity note the join sites rely on: once the budget
+                is spent nothing later in the tick is accepted, so a
+                budget-dropped JOINREP implies the (later-ordered) seed
+                burst to that joiner drops too — matching the reference,
+                where a full buffer stays full for the rest of the tick
+                (recvs only drain it next pass 1).  A COIN-dropped
+                JOINREP with a delivered burst is also faithful: the
+                reference rolls each ENsend independently."""
+                if mask.ndim == 1:
+                    csum = jnp.cumsum(mask.astype(I32)) + used_now
+                    kept = mask & (csum <= budget)
+                    return kept, used_now + kept.sum(dtype=I32)
+                cnt0 = mask.sum(1, dtype=I32)
+                starts = used_now + jnp.cumsum(cnt0) - cnt0
+                allowed = jnp.clip(budget - starts, 0, cnt0)
+                kept = mask & (jnp.cumsum(mask.astype(I32), axis=1)
+                               <= allowed[:, None])
+                return kept, used_now + allowed.sum(dtype=I32)
 
         # ---- pass 1: receive = elementwise admit-or-refresh combine ----
         # (make_admit: sticky admission.)  Acks apply first: their channel
@@ -579,7 +620,11 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
                 cand_full = jnp.concatenate(
                     [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
-                cand_full = jnp.roll(cand_full, ptr2, axis=1)
+                # ptr2 only takes multiples of gcd(P, S): static-roll
+                # switch instead of a full-plane dynamic lane roll.
+                cand_full = ptr_switch(
+                    ptr2, p_cnt, s,
+                    lambda o, c: jnp.roll(c, o, axis=1), cand_full)
                 ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
@@ -852,7 +897,14 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # pipeline above applies the refresh two ticks later.
             p_cnt = cfg.probes
             ptr = jax.lax.rem(t * p_cnt, s)
-            window = jnp.roll(view, -ptr, axis=1)[:, :p_cnt]
+            # The window is a cyclic P-column band at a pointer that only
+            # takes multiples of gcd(P, S): each switch branch is a
+            # static roll + static slice (a contiguous copy when the
+            # band doesn't wrap) instead of rolling the whole [N, S]
+            # plane dynamically to read P columns.
+            window = ptr_switch(
+                ptr, p_cnt, s,
+                lambda o, v: jnp.roll(v, -o, axis=1)[:, :p_cnt], view)
             w_pres = window > 0
             w_id = ((window - U32(1)) % U32(n)).astype(I32)
             p_valid = w_pres & (w_id != idx[:, None]) & act[:, None]

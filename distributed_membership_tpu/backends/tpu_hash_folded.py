@@ -151,6 +151,8 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
     tpu_hash.make_step ring), on P-folded probe state.  ``vec`` is the
     lagged heartbeat vector ([N]; the sharded caller passes its
     all_gather).  Returns (cand_sf [rows/F, 128], ack_recv_cnt [rows])."""
+    from distributed_membership_tpu.backends.tpu_hash import ptr_switch
+
     id2 = jnp.clip(ids2.astype(I32) - 1, 0)
     hb_ack = vec[id2]
     valid2 = (ids2 > 0) & (hb_ack > 0)
@@ -162,7 +164,11 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
         valid2, hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
     ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
     cand_ext = jnp.concatenate([cand.reshape(-1), jnp.zeros((1,), U32)])
-    cand_sf = roll_slots(cand_ext[cand_idx], ptr2, s)
+    # Pointer takes only multiples of gcd(P, S): switch over static
+    # roll_slots calls (every roll inside goes static — tpu_hash.ptr_switch).
+    cand_sf = ptr_switch(ptr2, p_cnt, s,
+                         lambda o, c: roll_slots(c, o, s),
+                         cand_ext[cand_idx])
     ack_recv_cnt = _sumP(valid2 & _repP(recv_mask, rows, fp, p_cnt),
                          rows, fp, p_cnt).astype(I32)
     return cand_sf, ack_recv_cnt
@@ -187,8 +193,11 @@ def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
                        node_p, k_drop, p_drop, use_drop, drop_active):
     """Issue this tick's probes from the cyclic window (P-folded).
     Returns (ids_new [rows/FP, 128] u32, p_valid bool)."""
+    from distributed_membership_tpu.backends.tpu_hash import ptr_switch
+
     ptr = jax.lax.rem(t * p_cnt, s)
-    rolled_w = roll_slots(view, (s - ptr) % s, s)
+    rolled_w = ptr_switch((s - ptr) % s, p_cnt, s,
+                          lambda o, v: roll_slots(v, o, s), view)
     window = rolled_w.reshape(-1)[window_idx]
     w_pres = window > 0
     w_id = ((window - U32(1)) % U32(n)).astype(I32)

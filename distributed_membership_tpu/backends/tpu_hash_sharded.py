@@ -67,7 +67,7 @@ from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_hash import (
     STRIDE, HashConfig, I32, U32, _credit_orphan_recvs_sharded,
-    _gathered_act, _gathered_flush, _pack_probe_bits,
+    _gathered_act, _gathered_flush, _pack_probe_bits, ptr_switch,
     _will_flush, make_admit, make_config, pack, slot_of, unpack)
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
@@ -435,7 +435,11 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
             cand_full = jnp.concatenate(
                 [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
-            cand_full = jnp.roll(cand_full, ptr2, axis=1)
+            # Static-roll switch over the pointer's multiples-of-gcd set
+            # (see tpu_hash.ptr_switch).
+            cand_full = ptr_switch(
+                ptr2, cfg.probes, s,
+                lambda o, c: jnp.roll(c, o, axis=1), cand_full)
             ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
@@ -607,7 +611,10 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         act_prev = state.act_prev
         if cfg.probes > 0:
             ptr = lax.rem(t * cfg.probes, s)
-            window = jnp.roll(view, -ptr, axis=1)[:, :cfg.probes]
+            window = ptr_switch(
+                ptr, cfg.probes, s,
+                lambda o, v: jnp.roll(v, -o, axis=1)[:, :cfg.probes],
+                view)
             w_pres = window > 0
             w_id = ((window - U32(1)) % U32(n)).astype(I32)
             p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]

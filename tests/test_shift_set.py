@@ -42,6 +42,32 @@ def test_static_delivery_matches_dynamic(n, s):
 
 
 @pytest.mark.quick
+def test_ptr_switch_matches_dynamic():
+    """ptr_switch's static dispatch must equal the traced fallback for
+    every reachable pointer value, including non-dividing P and the
+    too-many-branches fallback path."""
+    from distributed_membership_tpu.backends.tpu_hash import ptr_switch
+
+    key = jax.random.PRNGKey(5)
+    for (p, s) in ((2, 16), (8, 64), (12, 16), (3, 8)):
+        v = jax.random.randint(key, (32, s), 0, 1 << 20).astype(U32)
+        fn = lambda o, x: jnp.roll(x, -o, axis=1)[:, :min(p, s)]  # noqa: E731
+        import math
+        d = math.gcd(p, s)
+        for t in range(2 * s // d + 1):
+            ptr = (t * p) % s
+            got = ptr_switch(jnp.asarray(ptr, jnp.int32), p, s, fn, v)
+            want = fn(ptr, v)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want),
+                                          err_msg=f"p={p} s={s} ptr={ptr}")
+        # max_branches=1 forces the traced fallback on the same values.
+        got_fb = ptr_switch(jnp.asarray(p % s, jnp.int32), p, s, fn, v,
+                            max_branches=1)
+        np.testing.assert_array_equal(np.asarray(got_fb), fn(p % s, v))
+
+
+@pytest.mark.quick
 def test_shift_table_connected_and_in_range():
     for n in (256, 1 << 16, 1 << 20):
         tab = shift_table(n, 16)
