@@ -107,6 +107,12 @@ LADDER = [
     # scheduling cliff rather than per-byte cost.
     ("65k_s16",          1 << 16,  16, 150, "off",    240),
     ("262k_s16",         1 << 18,  16, 100, "off",    300),
+    # _v2 natural rows: the round-5 ptr_switch change removed two
+    # full-plane dynamic lane rolls per tick (probe window + ack
+    # placement) from the natural step — these re-measure the banked
+    # round-4 natural geometry on the new graph.
+    ("1M_s16_v2",        1 << 20,  16,  60, "off",    600),
+    ("65k_s16_v2",       1 << 16,  16, 150, "off",    240),
     # SHIFT_SET: the natural-layout roll mitigation (lax.switch over 16
     # static circulant shifts) at the cheap point and the north-star
     # point — decides VERDICT weak #4 together with the micro's
