@@ -52,8 +52,7 @@ def test_totals_equal_split_differs(backend):
     assert (r_ex != r_ap).any()
 
 
-@pytest.mark.quick
-def test_dead_target_sends_no_ack_in_either_mode():
+def test_dead_target_sends_no_ack_in_either_mode():   # ~7 s: full-tier
     """After the crash, the failed node's exact-mode ack sends stop; in
     approx mode the same acks vanish from the probers' rows — both modes
     lose the SAME global count (the act filter, not attribution)."""

@@ -41,8 +41,7 @@ def test_static_delivery_matches_dynamic(n, s):
                                       err_msg=f"shift {rv}")
 
 
-@pytest.mark.quick
-def test_ptr_switch_matches_dynamic():
+def test_ptr_switch_matches_dynamic():   # ~8 s: full-tier
     """ptr_switch's static dispatch must equal the traced fallback for
     every reachable pointer value, including non-dividing P and the
     too-many-branches fallback path."""
@@ -50,11 +49,12 @@ def test_ptr_switch_matches_dynamic():
 
     key = jax.random.PRNGKey(5)
     for (p, s) in ((2, 16), (8, 64), (12, 16), (3, 8)):
-        v = jax.random.randint(key, (32, s), 0, 1 << 20).astype(U32)
+        v = jax.random.randint(key, (8, s), 0, 1 << 20).astype(U32)
         fn = lambda o, x: jnp.roll(x, -o, axis=1)[:, :min(p, s)]  # noqa: E731
         import math
         d = math.gcd(p, s)
-        for t in range(2 * s // d + 1):
+        # One full pointer period covers every reachable value.
+        for t in range(s // d):
             ptr = (t * p) % s
             got = ptr_switch(jnp.asarray(ptr, jnp.int32), p, s, fn, v)
             want = fn(ptr, v)
