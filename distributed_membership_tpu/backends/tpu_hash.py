@@ -1227,10 +1227,6 @@ def make_config(params: Params, collect_events: bool = True,
                 "SHIFT_SET is single-chip tpu_hash only (the sharded "
                 "step's local rolls + collectives are a different "
                 "lowering; measure the mitigation single-chip first)")
-        if folded:
-            raise ValueError(
-                "SHIFT_SET is the NATURAL-layout roll mitigation; the "
-                "folded layout already rolls aligned 128-lane planes")
         if fused_g:
             raise ValueError(
                 "SHIFT_SET and FUSED_GOSSIP are incompatible (the "

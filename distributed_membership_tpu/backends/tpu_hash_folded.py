@@ -309,9 +309,43 @@ def make_folded_step(cfg):
 
         keep = _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum,
                           k_entries)
-        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        if cfg.shift_set:
+            # Static-table shifts (SHIFT_SET, same key stream and draw
+            # as tpu_hash.make_step so folded stays bit-exact with the
+            # natural sw run): with a Python-int shift, roll_nodes and
+            # roll_slots lower to STATIC rolls throughout — the folded
+            # gossip path carries zero dynamic lane rotates.
+            from distributed_membership_tpu.backends.tpu_hash import (
+                shift_table)
+            table = shift_table(n, cfg.shift_set)
+            shift_idx = jax.random.randint(
+                k_shifts, (k_max,), 0, cfg.shift_set)
+            shifts = jnp.asarray(table, I32)[shift_idx]
+        else:
+            shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
         sent_gossip = jnp.zeros((n,), I32)
         recv_add = jnp.zeros((n,), I32)
+
+        def deliver_folded(r, payload, cnt):
+            """One folded circulant delivery; ``r`` traced or Python int
+            (the SHIFT_SET switch branches — mirrors
+            tpu_hash.deliver_shift's dual contract)."""
+            static = isinstance(r, int)
+            s1 = ((r % s) * cstride % s if static
+                  else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
+            rolled = roll_nodes(payload, r, f, s)
+            r1 = roll_slots(rolled, s1, s)
+            if single_col_roll:
+                delivered = r1
+            else:
+                s2 = (((r - n) % s) * cstride % s if static
+                      else jax.lax.rem(
+                          jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
+                          * cstride, s))
+                r2 = roll_slots(rolled, s2, s)
+                delivered = jnp.where(rep((idx >= r)), r1, r2)
+            return delivered, jnp.roll(cnt, r)
+
         stacked = []      # (payload, r, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
@@ -323,24 +357,27 @@ def make_folded_step(cfg):
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
             sent_gossip = sent_gossip + cnt
-            recv_add = recv_add + jnp.roll(cnt, r)
-            s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
-            s2 = (jnp.asarray(0, I32) if single_col_roll else jax.lax.rem(
-                jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s))
             if cfg.fused_gossip:
                 # All shifts accumulate in ONE Pallas traversal below
                 # (ops/fused_folded.gossip_folded_stacked); payloads are
                 # fully masked here — including any drop masks — so the
                 # kernel is pure data movement.
+                recv_add = recv_add + jnp.roll(cnt, r)
+                s1 = jax.lax.rem(jax.lax.rem(r, s) * cstride, s)
+                s2 = (jnp.asarray(0, I32) if single_col_roll
+                      else jax.lax.rem(
+                          jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
+                          * cstride, s))
                 stacked.append((payload, r, s1, s2))
                 continue
-            rolled = roll_nodes(payload, r, f, s)
-            r1 = roll_slots(rolled, s1, s)
-            if single_col_roll:
-                delivered = r1
+            if cfg.shift_set:
+                delivered, cnt_r = jax.lax.switch(
+                    shift_idx[jshift],
+                    [(lambda pl, c, rv=rv: deliver_folded(rv, pl, c))
+                     for rv in table], payload, cnt)
             else:
-                r2 = roll_slots(rolled, s2, s)
-                delivered = jnp.where(rep((idx >= r)), r1, r2)
+                delivered, cnt_r = deliver_folded(r, payload, cnt)
+            recv_add = recv_add + cnt_r
             mail = jnp.maximum(mail, delivered)
         if cfg.fused_gossip and stacked:
             from distributed_membership_tpu.ops.fused_folded import (

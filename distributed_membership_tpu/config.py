@@ -179,7 +179,9 @@ class Params:
     # static shifts compile to aligned copies.  Protocol-visible change:
     # the gossip graph becomes a union of K fixed circulants (table
     # includes shift 1, so it stays connected; spread is golden-ratio).
-    # 0 = off (default).  Single-chip tpu_hash ring natural only.
+    # 0 = off (default).  Single-chip tpu_hash ring only; composes
+    # with FOLDED (the switch branches make roll_nodes/roll_slots
+    # fully static), not with FUSED_GOSSIP.
     SHIFT_SET: int = 0
 
     def getcurrtime(self) -> int:

@@ -119,6 +119,9 @@ LADDER = [
     # roll_rows_switch16 row.
     ("65k_s16_sw16",     1 << 16,  16, 150, "sw16",   300),
     ("1M_s16_sw16",      1 << 20,  16,  60, "sw16",   700),
+    # SHIFT_SET x FOLDED: static-table shifts make every folded roll
+    # static — the zero-dynamic-roll unfused candidate at S=16.
+    ("1M_s16_folded_sw16", 1 << 20, 16, 60, "folded_sw16", 1200),
     # Same-window s64 slope re-measure: the banked 262k (17:41Z) and
     # 524k (01:17Z) rows came from different relay windows with
     # IDENTICAL compiled programs (PERF.md compile diff) — adjacent
@@ -214,8 +217,10 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                "on" if fused in ("gossip", "both", "folded_fboth")
                else "off",
                "--folded",
-               "on" if fused in ("folded", "folded_fboth") else "off",
-               "--shift-set", "16" if fused == "sw16" else "0",
+               "on" if fused in ("folded", "folded_fboth", "folded_sw16")
+               else "off",
+               "--shift-set",
+               "16" if fused in ("sw16", "folded_sw16") else "0",
                "--prng", "rbg" if fused == "rbg" else "threefry2x32"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -265,14 +270,17 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
+    # 'rbg' swaps the key-stream impl and 'sw16' the shift-draw
+    # distribution on the plain jnp step — no Pallas kernel in the
+    # program, so no correctness family gates them (protocol validity
+    # pinned in tests/test_hash_backend.py and tests/test_shift_set.py).
     if (mode in ("off", "rbg", "sw16") or mode in BISECT_PHASES
             or corr is None):
-        # 'rbg' swaps the key-stream impl and 'sw16' the shift-draw
-        # distribution on the plain jnp step — no Pallas kernel in the
-        # program, so no correctness family gates them (protocol
-        # validity pinned in tests/test_hash_backend.py and
-        # tests/test_shift_set.py).
         return False
+    # 'folded_sw16' carries no Pallas kernel but still needs the folded
+    # LAYOUT's banked bit-exactness family clean: it falls through to
+    # the trailing folded_s{view} logic below (incl. the detail-free
+    # fail-closed guard), exactly like plain 'folded'.
     if mode == "folded_fboth" and not _corr_covers_ladder(corr):
         # The verdict predates the folded_fused families: fail closed
         # until a covering correctness run lands (_missing re-arms it).

@@ -44,7 +44,7 @@ def test_roll_decompositions(n, s):
 
 
 def _run(folded: int, drop: bool, n: int = 512, s: int = 16,
-         probes: int = 2, seed: int = 0):
+         probes: int = 2, seed: int = 0, shift_set: int = 0):
     dk = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: 0\nDROP_STOP: 90\n"
           if drop else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
     p = Params.from_text(
@@ -53,25 +53,29 @@ def _run(folded: int, drop: bool, n: int = 512, s: int = 16,
         f"PROBES: {probes}\nFANOUT: 3\nTFAIL: 16\n"
         "TREMOVE: 64\nTOTAL_TIME: 90\nFAIL_TIME: 40\nJOIN_MODE: warm\n"
         f"EVENT_MODE: agg\nEXCHANGE: ring\nFOLDED: {folded}\n"
-        "BACKEND: tpu_hash\n")
+        f"SHIFT_SET: {shift_set}\nBACKEND: tpu_hash\n")
     plan = make_plan(p, random.Random(f"app:{seed}"))
     return run_scan(p, plan, seed=seed, collect_events=False)
 
 
-@pytest.mark.parametrize("drop,n,s,probes,seed", [
-    (False, 512, 16, 2, 0),
-    (True, 512, 16, 2, 0),
+@pytest.mark.parametrize("drop,n,s,probes,seed,sw", [
+    (False, 512, 16, 2, 0, 0),
+    (True, 512, 16, 2, 0, 0),
     # Other fold factors: F=16 (S=8), F=4 (S=32), F=2 (S=64); a second
     # seed for trajectory diversity.
-    (False, 512, 8, 1, 1),
-    (False, 768, 32, 4, 0),
-    (True, 256, 64, 8, 1),
+    (False, 512, 8, 1, 1, 0),
+    (False, 768, 32, 4, 0, 0),
+    (True, 256, 64, 8, 1, 0),
+    # SHIFT_SET composition: the folded switch branches (fully static
+    # roll_nodes/roll_slots) must reproduce the natural sw trajectory.
+    (False, 512, 16, 2, 0, 8),
+    (True, 512, 16, 2, 1, 16),
 ])
-def test_folded_run_bit_exact(drop, n, s, probes, seed):
+def test_folded_run_bit_exact(drop, n, s, probes, seed, sw):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")   # small TREMOVE under loss is fine
-        f0, e0 = _run(0, drop, n, s, probes, seed)
-        f1, e1 = _run(1, drop, n, s, probes, seed)
+        f0, e0 = _run(0, drop, n, s, probes, seed, sw)
+        f1, e1 = _run(1, drop, n, s, probes, seed, sw)
     for name in ("view", "view_ts", "mail", "probe_ids1", "probe_ids2"):
         np.testing.assert_array_equal(
             np.asarray(getattr(f0, name)).reshape(-1),

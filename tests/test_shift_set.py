@@ -115,10 +115,12 @@ def test_config_gates():
         make_config(Params.from_text(
             base + "BACKEND: tpu_hash_sharded\nEXCHANGE: ring\n"
             "SHIFT_SET: 8\n"), collect_events=False)
-    with pytest.raises(ValueError, match="NATURAL"):
-        make_config(Params.from_text(
-            base + "BACKEND: tpu_hash\nEXCHANGE: ring\nFOLDED: 1\n"
-            "SHIFT_SET: 8\n"), collect_events=False)
+    # FOLDED composes (static roll_nodes/roll_slots in the switch
+    # branches; bit-exactness pinned in tests/test_folded.py).
+    cfg = make_config(Params.from_text(
+        base + "BACKEND: tpu_hash\nEXCHANGE: ring\nFOLDED: 1\n"
+        "SHIFT_SET: 8\n"), collect_events=False)
+    assert cfg.folded and cfg.shift_set == 8
     with pytest.raises(ValueError, match="FUSED_GOSSIP"):
         make_config(Params.from_text(
             base.replace("VIEW_SIZE: 16", "VIEW_SIZE: 128")
