@@ -1154,7 +1154,10 @@ def make_config(params: Params, collect_events: bool = True,
             if fr_knob == -1:
                 fr_knob = int(kernels_ok)
             if fg_knob == -1:
-                fg_knob = int(kernels_ok)
+                # The gossip kernel conflicts with SHIFT_SET (loud gate
+                # below); auto must keep it off rather than resolve into
+                # the error — mirrors the natural-path guard.
+                fg_knob = int(kernels_ok and not params.SHIFT_SET)
         else:
             if fr_knob == -1:
                 fr_knob = int(

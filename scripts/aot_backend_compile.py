@@ -175,6 +175,17 @@ def main() -> int:
             return p
         attempt("approx_lag",
                 lambda: backend_compile(_lag_params(), sharding))
+    for sw_name, sw_folded in (("sw16", False), ("folded_sw16", True)):
+        if args.variant and args.variant != sw_name:
+            continue
+        matched += 1
+
+        def _sw_params(folded=sw_folded):
+            p = _conf(4096, 16, False, False, False, folded)
+            p.SHIFT_SET = 16
+            p.validate()
+            return p
+        attempt(sw_name, lambda f=_sw_params: backend_compile(f(), sharding))
     for (name, n, s, fr, fg, drops, folded, dims) in SHARDED_VARIANTS:
         if args.variant and name != args.variant:
             continue

@@ -65,6 +65,25 @@ def test_auto_on_with_banked_clean_record(tmp_path, monkeypatch):
 
 
 @pytest.mark.quick
+def test_auto_never_raises_under_shift_set(tmp_path, monkeypatch):
+    """SHIFT_SET conflicts with FUSED_GOSSIP via a loud gate; the auto
+    knobs must resolve AROUND it (gossip kernel off, receive kernel
+    still on), never INTO it — on the natural path, with auto FOLDED,
+    and with FOLDED pinned on."""
+    _bank(tmp_path, monkeypatch, CLEAN)
+    monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
+    cfg = make_config(_params(s=16, extra="SHIFT_SET: 16\n"),
+                      collect_events=False)
+    assert not cfg.folded          # auto-folded stays off under the knob
+    assert not cfg.fused_gossip
+    cfgf = make_config(_params(s=16, extra="SHIFT_SET: 16\nFOLDED: 1\n"),
+                       collect_events=False)
+    assert cfgf.folded and cfgf.shift_set == 16
+    assert cfgf.fused_receive      # receive kernel composes
+    assert not cfgf.fused_gossip   # gossip kernel auto-resolves off
+
+
+@pytest.mark.quick
 def test_auto_respects_per_family_verdicts(tmp_path, monkeypatch):
     monkeypatch.setenv("DM_RESOLVED_PLATFORM", "tpu")
     dirty = dict(CLEAN)

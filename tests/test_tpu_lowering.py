@@ -103,6 +103,18 @@ def test_full_scan_lowers_for_tpu(name, n, s, fr, fg, drops, folded):
 
 
 @pytest.mark.quick
+@pytest.mark.parametrize("folded", [False, True], ids=["natural", "folded"])
+def test_shift_set_scan_lowers_for_tpu(folded):
+    """The SHIFT_SET ladder rungs (sw16 / folded_sw16) must not discover
+    a lowering gap on the chip: the lax.switch-over-static-rolls gossip
+    delivery has to make it through the TPU pipeline on both layouts."""
+    p = _conf(4096, 16, False, False, False, folded)
+    p.SHIFT_SET = 16
+    p.validate()
+    _lower_for_tpu(p)
+
+
+@pytest.mark.quick
 @pytest.mark.parametrize("impl", ["rbg", "unsafe_rbg"])
 def test_rbg_scan_lowers_for_tpu(impl):
     """The PRNG_IMPL rbg ladder rungs must not discover a lowering gap on
