@@ -112,21 +112,58 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         shift_set = int(os.environ.get("BENCH_SHIFT_SET", "0"))
     except ValueError:
         raise SystemExit("BENCH_SHIFT_SET must be an integer K (0 = off); "
-                         "Params validates the 2..64 range")
+                         "valid K are 2..64")
+    if shift_set and not 2 <= shift_set <= 64:
+        # Same env-var handling style as BENCH_FOLDED: a friendly exit at
+        # the parse site, not a raw ValueError traceback out of
+        # Params.from_text.
+        raise SystemExit(f"BENCH_SHIFT_SET must be 0 (off) or 2..64, "
+                         f"got {shift_set}")
     fused_keys = (
         ("FUSED_RECEIVE: -1\nFUSED_GOSSIP: -1\n" if fused == "auto" else
          f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
          f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n")
         + ("FOLDED: -1\n" if folded == "auto" else
            f"FOLDED: {int(folded == 'on')}\n"))
-    params = Params.from_text(
+    params_text = (
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
         f"TFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n{fused_keys}"
         f"SHIFT_SET: {shift_set}\nBACKEND: tpu_hash\n")
+    params = Params.from_text(params_text)
     plan = make_plan(params, _pyrandom.Random("app:0"))
     wall, final_state = _timed_runs(run_scan, params, plan, ticks)
+
+    # BENCH_CHECKPOINT=K: measure the resilient-run harness's overhead —
+    # the same leg re-timed with the tick loop in K-tick checkpointed
+    # segments (runtime/checkpoint.py), snapshots written to a temp dir.
+    # Reported as extra fields; the headline number stays the monolithic
+    # run's.
+    try:
+        ckpt_every = int(os.environ.get("BENCH_CHECKPOINT", "0"))
+    except ValueError:
+        raise SystemExit("BENCH_CHECKPOINT must be an integer segment "
+                         "length in ticks (0 = off)")
+    ckpt_fields = {}
+    if ckpt_every > 0:
+        import glob
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as ckdir:
+            params_ck = Params.from_text(
+                params_text + f"CHECKPOINT_EVERY: {ckpt_every}\n"
+                f"CHECKPOINT_DIR: {ckdir}\n")
+            ck_wall, _ = _timed_runs(run_scan, params_ck, plan, ticks)
+            kept = glob.glob(os.path.join(ckdir, "ckpt_*.npz"))
+            ck_bytes = sum(os.path.getsize(p) for p in kept)
+        ckpt_fields = {
+            "checkpoint_every": ckpt_every,
+            "checkpoint_wall_seconds": round(ck_wall, 3),
+            "checkpoint_overhead_pct": round(100 * (ck_wall - wall)
+                                             / max(wall, 1e-9), 1),
+            "checkpoint_bytes_per_snapshot": ck_bytes // max(len(kept), 1),
+        }
 
     # Approximate HBM traffic: full passes over the resident state per tick.
     # scatter: view+ts+mail+amail [N,S] u32 + pmail [N,Qp], reads+writes.
@@ -169,6 +206,7 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         "est_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
         "view_size": cfg.s, "probes": cfg.probes, "fanout": cfg.fanout,
         "exchange": cfg.exchange,
+        **ckpt_fields,
     }
 
 
@@ -198,7 +236,8 @@ def leg_dense(n: int, ticks: int, pin: str | None) -> dict:
 # --------------------------------------------------------------------------
 # Orchestrator
 
-def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
+def _best_banked_tpu(art_dir: str | None = None,
+                     match: dict | None = None) -> dict | None:
     """Best previously-banked real-TPU hash-leg row, for headline fallback.
 
     When the relay is down at capture time, a live CPU number must not be
@@ -206,7 +245,9 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
     evidence from artifacts/TPU_PROFILE.json (warm-cache ladder rungs) or
     artifacts/SCALE_SMOKE.json (compile-included scale rows), tagged with
     its provenance so the reader knows it is banked, not live.
-    ``art_dir`` overrides the artifacts directory (tests).
+    ``art_dir`` overrides the artifacts directory (tests).  ``match``
+    restricts candidates to the same (n, shift_set) protocol point as the
+    given live row — the displacement-eligibility rule (ADVICE r5 #1).
     """
     here = art_dir or os.path.dirname(os.path.abspath(__file__))
     rows = []
@@ -248,6 +289,7 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
             rows.append({
                 "n": r["n"],
                 "mode": mode,
+                "shift_set": r.get("shift_set", 0) or 0,
                 "view_size": s,
                 "probes": r.get("probes", 0),
                 "fanout": r.get("fanout", 0),
@@ -264,6 +306,10 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
                 "banked_from": f"artifacts/{fname}",
                 "banked_timestamp": r.get("timestamp"),
             })
+    if match is not None:
+        rows = [r for r in rows
+                if r["n"] == match["n"]
+                and r["shift_set"] == (match.get("shift_set") or 0)]
     if not rows:
         return None
     # Highest throughput wins; warm-cache provenance only breaks ties.
@@ -273,6 +319,22 @@ def _best_banked_tpu(art_dir: str | None = None) -> dict | None:
     rows.sort(key=lambda r: (r["node_ticks_per_sec"],
                              r["timing"] == "warm_cache"))
     return rows[-1]
+
+
+def _banked_displaces_live(banked: dict | None, live: dict) -> bool:
+    """Whether a banked TPU row may displace a LIVE TPU measurement as the
+    headline: it must be faster AND describe the same protocol point —
+    same n and same SHIFT_SET (a +swK row restricts the gossip graph to K
+    fixed circulants, a protocol-visible change; it may only appear as an
+    explicitly-labeled alternate, never silently as the headline the
+    reference comparison implies — ADVICE r5 #1)."""
+    if banked is None:
+        return False
+    if banked["node_ticks_per_sec"] <= live["node_ticks_per_sec"]:
+        return False
+    return (banked["n"] == live["n"]
+            and (banked.get("shift_set") or 0)
+            == (live.get("shift_set") or 0))
 
 
 def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
@@ -423,25 +485,30 @@ def main() -> int:
         hash_alt = hash16_res
 
     # Headline selection: the best TPU evidence wins.  A live CPU number
-    # never headlines over banked real-chip rows (VERDICT r2 weak-1), and
-    # a live TPU row yields to a FASTER banked TPU row (e.g. a ladder
-    # rung on a fast-mode config the live leg didn't run) — the metric
-    # string carries the provenance either way.
+    # never headlines over banked real-chip rows (VERDICT r2 weak-1).  A
+    # live TPU row yields only to a faster banked TPU row at the SAME
+    # (n, shift_set) protocol point (_banked_displaces_live); a faster
+    # banked row at a different point — notably +swK shift-set rows —
+    # stays an explicitly-labeled alternate under "banked_alt".
     live_cpu = None
+    banked_alt = None
     if hash_res is not None and hash_res.get("platform") != "tpu":
         banked = _best_banked_tpu()
         if banked is not None:
             live_cpu = hash_res
             hash_res = banked
     elif hash_res is not None:
-        banked = _best_banked_tpu()
-        if (banked is not None and banked["node_ticks_per_sec"]
-                > hash_res["node_ticks_per_sec"]):
+        eligible = _best_banked_tpu(match=hash_res)
+        if _banked_displaces_live(eligible, hash_res):
             # Keep the live row visible as the alternate regime slot if
             # it's free; the banked best headlines.
             if hash_alt is None:
                 hash_alt = hash_res
-            hash_res = banked
+            hash_res = eligible
+        best_any = _best_banked_tpu()
+        if (best_any is not None and best_any["node_ticks_per_sec"]
+                > hash_res["node_ticks_per_sec"]):
+            banked_alt = best_any
 
     if hash_res is None:
         hash_res = _best_banked_tpu()
@@ -490,6 +557,14 @@ def main() -> int:
                             "platform", "node_ticks_per_sec",
                             "ticks_per_sec", "wall_seconds")
                            if k in hash_alt}
+    if banked_alt is not None:
+        # Faster banked evidence at a DIFFERENT (n, shift_set) point than
+        # the live headline: reported, labeled, never the headline.
+        out["banked_alt"] = {k: banked_alt[k] for k in
+                             ("n", "ticks", "view_size", "exchange",
+                              "mode", "shift_set", "node_ticks_per_sec",
+                              "ticks_per_sec", "banked_from", "timing")
+                             if k in banked_alt}
     if dense_res is not None and (dense_res["node_ticks_per_sec"]
                                   < REFERENCE_NODE_TICKS_PER_SEC):
         # The dense leg is the O(N^2) exact-parity path at many times the

@@ -191,7 +191,14 @@ def shift_table(n: int, k: int) -> tuple:
     golden-ratio-spread values in [1, n).  Entry 0 is shift 1, so the
     union-of-K-circulants gossip graph always contains the full ring
     cycle and stays connected regardless of n's factorization."""
-    return tuple(1 + (h * 2654435761) % (n - 1) for h in range(k))
+    tab = tuple(1 + (h * 2654435761) % (n - 1) for h in range(k))
+    # K distinct shifts is what "K-way diversity, uniform draw" means;
+    # it currently holds because the multiplier is prime (coprime to any
+    # n-1 < 2^32), but a constant/formula tweak must fail HERE, not skew
+    # the shift distribution silently (ADVICE r5 #3).
+    assert len(set(tab)) == k, (
+        f"shift_table({n}, {k}) produced duplicate shifts: {tab}")
+    return tab
 
 
 def _pack_probe_bits(will_flush, act):
@@ -1288,20 +1295,7 @@ _RUNNER_CACHE: dict = {}
 def _get_runner(cfg: HashConfig, warm: bool):
     cache_key = (cfg, warm)
     if cache_key not in _RUNNER_CACHE:
-        if cfg.folded and cfg.probe_io_lag:
-            raise ValueError(
-                "PROBE_IO approx_lag requires the natural layout "
-                "(FOLDED: 0) — the folded step keeps the two-gather "
-                "attribution")
-        if cfg.folded:
-            from distributed_membership_tpu.backends.tpu_hash_folded import (
-                init_state_warm_folded, make_folded_step)
-            step = make_folded_step(cfg)
-            init = lambda warm_key: init_state_warm_folded(cfg, warm_key)  # noqa: E731
-        else:
-            step = make_step(cfg)
-            init = lambda warm_key: (init_state_warm(cfg, warm_key) if warm  # noqa: E731
-                                     else init_state(cfg))
+        step, init = _get_step_and_init(cfg, warm)
 
         def run(keys, ticks, start_ticks, fail_mask, fail_time,
                 drop_lo, drop_hi, warm_key):
@@ -1340,6 +1334,50 @@ def _get_runner(cfg: HashConfig, warm: bool):
     return _RUNNER_CACHE[cache_key]
 
 
+def _get_step_and_init(cfg: HashConfig, warm: bool):
+    """(step, init(warm_key)) for the natural or folded layout — the
+    single source both the whole-run and segment runners build from."""
+    if cfg.folded and cfg.probe_io_lag:
+        raise ValueError(
+            "PROBE_IO approx_lag requires the natural layout "
+            "(FOLDED: 0) — the folded step keeps the two-gather "
+            "attribution")
+    if cfg.folded:
+        from distributed_membership_tpu.backends.tpu_hash_folded import (
+            init_state_warm_folded, make_folded_step)
+        return (make_folded_step(cfg),
+                lambda warm_key: init_state_warm_folded(cfg, warm_key))
+    return (make_step(cfg),
+            lambda warm_key: (init_state_warm(cfg, warm_key) if warm
+                              else init_state(cfg)))
+
+
+def _get_segment_runner(cfg: HashConfig, warm: bool):
+    """Chunked-scan twin of :func:`_get_runner`: the carry is an argument,
+    so the run can stop at any segment boundary and continue bit-exactly
+    (runtime/checkpoint.py).  probe_io_lag is excluded by config
+    validation (its counter epilogue rides the whole-run scan)."""
+    cache_key = (cfg, warm, "segment")
+    if cache_key not in _RUNNER_CACHE:
+        if cfg.probe_io_lag:
+            raise ValueError(
+                "CHECKPOINT_EVERY is incompatible with PROBE_IO "
+                "approx_lag")
+        step, _ = _get_step_and_init(cfg, warm)
+
+        def run_seg(state, ticks, keys, start_ticks, fail_mask, fail_time,
+                    drop_lo, drop_hi):
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask,
+                                    fail_time, drop_lo, drop_hi))
+
+            return jax.lax.scan(body, state, (ticks, keys))
+
+        _RUNNER_CACHE[cache_key] = jax.jit(run_seg)
+    return _RUNNER_CACHE[cache_key]
+
+
 def plan_fail_ids(plan: FailurePlan) -> tuple:
     """The static failed-id list make_config needs for the FastAgg path.
 
@@ -1357,6 +1395,19 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     # Same effective-run-length packing guard as tpu_sparse.run_scan.
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"
+
+    if params.CHECKPOINT_EVERY > 0:
+        from distributed_membership_tpu.runtime.checkpoint import (
+            chunked_run, compact_sparse)
+        _, init = _get_step_and_init(cfg, warm)
+        warm_key = make_run_key(params, seed ^ 0x5EED)
+        return chunked_run(
+            params, plan, seed, total,
+            init_carry=lambda: init(warm_key),
+            segment_fn=_get_segment_runner(cfg, warm),
+            collect_events=collect_events,
+            compact_fn=compact_sparse if collect_events else None,
+            event_type=None if collect_events else SparseTickEvents)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)

@@ -60,7 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from distributed_membership_tpu.parallel import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX
@@ -1093,34 +1093,60 @@ def reduce_agg(agg: AggStats, ax=NODE_AXIS) -> AggStats:
 _RUNNER_CACHE: dict = {}
 
 
+def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
+    """(step, init, state_spec, out_spec, AX) — the shared construction of
+    the whole-run and chunked segment runners, single-sourced so the two
+    cannot drift (the segment runner's bit-exactness with the whole-run
+    scan is a test contract, tests/test_checkpoint.py)."""
+    axes = tuple(mesh.axis_names)
+    axis_sizes = tuple(mesh.shape[a] for a in axes)
+    n_shards = int(np.prod(axis_sizes))
+    AX = axes if len(axes) > 1 else axes[0]
+    ring = cfg.exchange == "ring"
+    if len(axes) > 1 and not ring:
+        raise ValueError(
+            "2-D torus meshes require EXCHANGE ring (the bucketed "
+            "all_to_all exchange is 1-D only)")
+    if cfg.folded:
+        from distributed_membership_tpu.backends.tpu_hash_folded import (
+            init_local_state_warm_folded, make_ring_sharded_folded_step)
+        step = make_ring_sharded_folded_step(cfg, n_local, n_shards,
+                                             axes=axes,
+                                             axis_sizes=axis_sizes)
+        init = lambda k: init_local_state_warm_folded(  # noqa: E731
+            cfg, n_local, k, ax=AX)
+    else:
+        step = (make_ring_sharded_step(cfg, n_local, n_shards,
+                                       cold_join=not warm, axes=axes,
+                                       axis_sizes=axis_sizes) if ring
+                else make_sharded_step(cfg, n_local, n_shards))
+        init = lambda k: (init_local_state_warm(cfg, n_local, k,  # noqa: E731
+                                                ax=AX)
+                          if warm else init_local_state(cfg, n_local))
+
+    # The reduced (or untouched-zero) agg is replicated; everything
+    # else is node-sharded (over BOTH axes when the mesh is 2-D —
+    # P(axes-tuple) is the outer-major flattening AX flattens to).
+    agg_t = FastAgg if cfg.fast_agg else AggStats
+    agg_spec = agg_t(*(P() for _ in agg_t._fields))
+    state_spec = ShardedHashState(
+        **{f: (agg_spec if f == "agg" else P(axes))
+           for f in ShardedHashState._fields})
+    if cfg.collect_events:
+        out_spec = SparseTickEvents(
+            join_ids=P(None, axes, None),
+            rm_ids=P(None, axes, None),
+            sent=P(None, axes), recv=P(None, axes))
+    else:
+        out_spec = SparseTickEvents(P(None), P(None), P(None), P(None))
+    return step, init, state_spec, out_spec, AX
+
+
 def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     cache_key = (cfg, n_local, mesh, warm)
     if cache_key not in _RUNNER_CACHE:
-        axes = tuple(mesh.axis_names)
-        axis_sizes = tuple(mesh.shape[a] for a in axes)
-        n_shards = int(np.prod(axis_sizes))
-        AX = axes if len(axes) > 1 else axes[0]
-        ring = cfg.exchange == "ring"
-        if len(axes) > 1 and not ring:
-            raise ValueError(
-                "2-D torus meshes require EXCHANGE ring (the bucketed "
-                "all_to_all exchange is 1-D only)")
-        if cfg.folded:
-            from distributed_membership_tpu.backends.tpu_hash_folded import (
-                init_local_state_warm_folded, make_ring_sharded_folded_step)
-            step = make_ring_sharded_folded_step(cfg, n_local, n_shards,
-                                                 axes=axes,
-                                                 axis_sizes=axis_sizes)
-            init = lambda k: init_local_state_warm_folded(  # noqa: E731
-                cfg, n_local, k, ax=AX)
-        else:
-            step = (make_ring_sharded_step(cfg, n_local, n_shards,
-                                           cold_join=not warm, axes=axes,
-                                           axis_sizes=axis_sizes) if ring
-                    else make_sharded_step(cfg, n_local, n_shards))
-            init = lambda k: (init_local_state_warm(cfg, n_local, k,  # noqa: E731
-                                                    ax=AX)
-                              if warm else init_local_state(cfg, n_local))
+        step, init, state_spec, out_spec, AX = _build_step(
+            cfg, n_local, mesh, warm)
 
         def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
                       drop_lo, drop_hi, warm_key):
@@ -1138,25 +1164,81 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
                         final_state.agg, ax=AX))
             return final_state, out
 
-        # The reduced (or untouched-zero) agg is replicated; everything
-        # else is node-sharded (over BOTH axes when the mesh is 2-D —
-        # P(axes-tuple) is the outer-major flattening AX flattens to).
-        agg_t = FastAgg if cfg.fast_agg else AggStats
-        agg_spec = agg_t(*(P() for _ in agg_t._fields))
-        state_spec = ShardedHashState(
-            **{f: (agg_spec if f == "agg" else P(axes))
-               for f in ShardedHashState._fields})
-        if cfg.collect_events:
-            out_spec = SparseTickEvents(
-                join_ids=P(None, axes, None),
-                rm_ids=P(None, axes, None),
-                sent=P(None, axes), recv=P(None, axes))
-        else:
-            out_spec = SparseTickEvents(P(None), P(None), P(None), P(None))
-
         sharded = shard_map(
             whole_run, mesh=mesh,
             in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(state_spec, out_spec),
+            check_vma=False,
+        )
+        _RUNNER_CACHE[cache_key] = jax.jit(sharded)
+    return _RUNNER_CACHE[cache_key]
+
+
+def _get_init_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
+    """shard_map'd initial-carry builder for the chunked driver: outputs
+    the GLOBAL carry representation the segment runner round-trips
+    (node-sharded fields concatenated; agg replicated — in aggregate mode
+    the agg slot carries the cross-segment ACCUMULATED global aggregates,
+    so it is initialized in the reduced/global shape)."""
+    cache_key = (cfg, n_local, mesh, warm, "init")
+    if cache_key not in _RUNNER_CACHE:
+        _, init, state_spec, _, AX = _build_step(cfg, n_local, mesh, warm)
+
+        def init_run(warm_key):
+            state0 = init(warm_key)
+            if not cfg.collect_events:
+                state0 = state0._replace(
+                    agg=(init_fast_agg(len(cfg.fail_ids), cfg.n)
+                         if cfg.fast_agg else init_agg(cfg.n)))
+            return state0
+
+        _RUNNER_CACHE[cache_key] = jax.jit(shard_map(
+            init_run, mesh=mesh, in_specs=(P(),), out_specs=state_spec,
+            check_vma=False))
+    return _RUNNER_CACHE[cache_key]
+
+
+def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
+                        warm: bool):
+    """Chunked-scan twin of :func:`_get_runner` (runtime/checkpoint.py).
+
+    The carry crosses the shard_map boundary in its global representation
+    (the same one the whole-run out_specs produce).  In aggregate mode the
+    carried agg slot holds the cross-segment accumulated GLOBAL
+    aggregates: the segment ignores it, accumulates fresh per-shard
+    partials from zero, and returns them reduced — the chunked adapter in
+    :func:`run_scan_sharded` merges segment results host-side
+    (observability/aggregates.merge_agg)."""
+    cache_key = (cfg, n_local, mesh, warm, "segment")
+    if cache_key not in _RUNNER_CACHE:
+        step, _, state_spec, out_spec, AX = _build_step(
+            cfg, n_local, mesh, warm)
+
+        def seg_run(state, ticks, keys, start_ticks, fail_mask_g,
+                    fail_time, drop_lo, drop_hi):
+            if not cfg.collect_events:
+                # The incoming agg is the accumulated global value (shape
+                # ≠ the per-shard partials); start this segment's
+                # partials from the local zero identity.
+                state = state._replace(
+                    agg=(init_fast_agg(len(cfg.fail_ids), n_local)
+                         if cfg.fast_agg else init_agg(cfg.n, n_local)))
+
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask_g,
+                                    fail_time, drop_lo, drop_hi))
+
+            final_state, out = lax.scan(body, state, (ticks, keys))
+            if not cfg.collect_events:
+                final_state = final_state._replace(
+                    agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
+                        final_state.agg, ax=AX))
+            return final_state, out
+
+        sharded = shard_map(
+            seg_run, mesh=mesh,
+            in_specs=(state_spec, P(), P(), P(), P(), P(), P(), P()),
             out_specs=(state_spec, out_spec),
             check_vma=False,
         )
@@ -1253,6 +1335,33 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"
+
+    if params.CHECKPOINT_EVERY > 0:
+        from distributed_membership_tpu.observability.aggregates import (
+            merge_agg)
+        from distributed_membership_tpu.runtime.checkpoint import (
+            chunked_run, compact_sparse)
+        init_run = _get_init_runner(cfg, n_local, mesh, warm)
+        seg = _get_segment_runner(cfg, n_local, mesh, warm)
+        warm_key = make_run_key(params, seed ^ 0x5EED)
+
+        def segment_fn(carry, *rest):
+            new_state, ev = seg(carry, *rest)
+            if not collect_events:
+                # The carried agg slot is the cross-segment GLOBAL
+                # accumulator; the segment returned its own reduced
+                # contribution — merge host-side (disjoint tick ranges).
+                new_state = new_state._replace(agg=merge_agg(
+                    jax.tree.map(np.asarray, carry.agg),
+                    jax.tree.map(np.asarray, new_state.agg)))
+            return new_state, ev
+
+        return chunked_run(
+            params, plan, seed, total,
+            init_carry=lambda: init_run(warm_key),
+            segment_fn=segment_fn, collect_events=collect_events,
+            compact_fn=compact_sparse if collect_events else None,
+            event_type=None if collect_events else SparseTickEvents)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from distributed_membership_tpu.parallel import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_membership_tpu.addressing import INTRODUCER_INDEX

@@ -476,6 +476,27 @@ def _get_runner(cfg: SparseConfig, warm: bool):
     return _RUNNER_CACHE[cache_key]
 
 
+def _get_segment_runner(cfg: SparseConfig):
+    """Chunked-scan twin of :func:`_get_runner`: the carry is an argument,
+    so the run can stop at any segment boundary and continue bit-exactly
+    (runtime/checkpoint.py)."""
+    cache_key = (cfg, "segment")
+    if cache_key not in _RUNNER_CACHE:
+        step = make_step(cfg)
+
+        def run_seg(state, ticks, keys, start_ticks, fail_mask, fail_time,
+                    drop_lo, drop_hi):
+            def body(state, inp):
+                t, k = inp
+                return step(state, (t, k, start_ticks, fail_mask,
+                                    fail_time, drop_lo, drop_hi))
+
+            return jax.lax.scan(body, state, (ticks, keys))
+
+        _RUNNER_CACHE[cache_key] = jax.jit(run_seg)
+    return _RUNNER_CACHE[cache_key]
+
+
 def run_scan(params: Params, plan: FailurePlan, seed: int,
              collect_events: bool = True, total_time: Optional[int] = None):
     """Run the full simulation; returns (final_state, events)."""
@@ -487,6 +508,19 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     # the uint32 (heartbeat, id) packing guard.
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"
+
+    if params.CHECKPOINT_EVERY > 0:
+        from distributed_membership_tpu.runtime.checkpoint import (
+            chunked_run, compact_sparse)
+        warm_key = make_run_key(params, seed ^ 0x5EED)
+        return chunked_run(
+            params, plan, seed, total,
+            init_carry=lambda: (init_state_warm(cfg, warm_key) if warm
+                                else init_state(cfg)),
+            segment_fn=_get_segment_runner(cfg),
+            collect_events=collect_events,
+            compact_fn=compact_sparse if collect_events else None,
+            event_type=None if collect_events else SparseTickEvents)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
@@ -500,24 +534,26 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
 
 def events_to_log(params: Params, plan: FailurePlan, events: SparseTickEvents,
                   log: EventLog) -> None:
-    """Reconstruct dbg.log from stacked sparse event tensors (same line
-    inventory as the dense backend's events_to_log, backends/tpu.py)."""
+    """Reconstruct dbg.log from stacked sparse event tensors — or from
+    their chunked-run host compaction — (same line inventory as the dense
+    backend's events_to_log, backends/tpu.py)."""
+    from distributed_membership_tpu.runtime.checkpoint import (
+        CompactEvents, compact_sparse)
+
+    if not isinstance(events, CompactEvents):
+        events = compact_sparse(events)
     n = params.EN_GPSZ
-    total = events.join_ids.shape[0]
+    total = events.total
     starts = [params.start_tick(i) for i in range(n)]
     for i in range(n):
         log.log(i + 1, 0, "APP")
 
-    joins_t, joins_i, joins_s = np.nonzero(events.join_ids != EMPTY)
-    removes_t, removes_i, removes_s = np.nonzero(events.rm_ids != EMPTY)
     join_by_tick: dict = {}
-    for t, i, s in zip(joins_t, joins_i, joins_s):
-        join_by_tick.setdefault(int(t), []).append(
-            (int(i), int(events.join_ids[t, i, s])))
+    for t, i, j in events.joins:
+        join_by_tick.setdefault(int(t), []).append((int(i), int(j)))
     remove_by_tick: dict = {}
-    for t, i, s in zip(removes_t, removes_i, removes_s):
-        remove_by_tick.setdefault(int(t), []).append(
-            (int(i), int(events.rm_ids[t, i, s])))
+    for t, i, j in events.removes:
+        remove_by_tick.setdefault(int(t), []).append((int(i), int(j)))
 
     intro_failed = (plan.fail_time is not None
                     and INTRODUCER_INDEX in plan.failed_indices)

@@ -183,6 +183,29 @@ class Params:
     # with FOLDED (the switch branches make roll_nodes/roll_slots
     # fully static), not with FUSED_GOSSIP.
     SHIFT_SET: int = 0
+    # Resilient-run harness (runtime/checkpoint.py): run the tick loop in
+    # CHECKPOINT_EVERY-tick lax.scan segments instead of one monolithic
+    # whole-run scan.  Between segments the full carry is pulled to host
+    # and — when CHECKPOINT_DIR is set — snapshotted to a versioned
+    # on-disk checkpoint (atomic write-rename + manifest), so a run
+    # killed by a flaky relay resumes from the last segment instead of
+    # producing nothing.  Chunking is bit-exact with the monolithic scan
+    # (same step function, same per-tick fold_in key stream — pinned in
+    # tests/test_checkpoint.py) and bounds the EVENT_MODE=full stacked
+    # event tensors at O(CHECKPOINT_EVERY * N * M) device memory instead
+    # of O(T * N * M).  0 = off (monolithic scan, the default).
+    # Supported by the jitted backends (tpu, tpu_sparse, tpu_hash incl.
+    # FOLDED, tpu_hash_sharded); the host emul paths reject it loudly.
+    CHECKPOINT_EVERY: int = 0
+    # Directory for checkpoint snapshots + MANIFEST.json ('' = chunk the
+    # scan but persist nothing — the memory win without the disk I/O).
+    CHECKPOINT_DIR: str = ""
+    # 1 = resume from CHECKPOINT_DIR's latest valid checkpoint when one
+    # exists (manifest validated against this config/seed — a mismatch
+    # raises instead of silently computing a different run); when none
+    # exists the run starts fresh, so retry loops can always pass
+    # RESUME: 1.  Requires CHECKPOINT_EVERY > 0 and CHECKPOINT_DIR.
+    RESUME: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -264,6 +287,31 @@ class Params:
                 f"SHIFT_SET must be 0 (off) or 2..64 static shift "
                 f"candidates (got {self.SHIFT_SET}); each candidate adds "
                 f"a lax.switch branch to the compiled step")
+        if self.CHECKPOINT_EVERY < 0:
+            raise ValueError(
+                f"CHECKPOINT_EVERY must be >= 0 (0 = off), got "
+                f"{self.CHECKPOINT_EVERY}")
+        if self.CHECKPOINT_EVERY and self.BACKEND in (
+                "emul", "emul_native", "tpu_sharded"):
+            # Loud-rejection policy: the host emul loops and the legacy
+            # dense-sharded path have no chunked driver — silently running
+            # monolithic would drop the crash tolerance the key asks for.
+            raise ValueError(
+                f"CHECKPOINT_EVERY is not supported by BACKEND "
+                f"{self.BACKEND!r} (chunked drivers: tpu, tpu_sparse, "
+                "tpu_hash, tpu_hash_sharded)")
+        if self.CHECKPOINT_EVERY and self.PROBE_IO == "approx_lag":
+            raise ValueError(
+                "CHECKPOINT_EVERY is incompatible with PROBE_IO "
+                "approx_lag (its counter epilogue rides the whole-run "
+                "scan)")
+        if self.RESUME not in (0, 1):
+            raise ValueError(f"RESUME must be 0 or 1, got {self.RESUME!r}")
+        if self.RESUME and not (self.CHECKPOINT_EVERY
+                                and self.CHECKPOINT_DIR):
+            raise ValueError(
+                "RESUME: 1 requires CHECKPOINT_EVERY > 0 and a "
+                "CHECKPOINT_DIR to resume from")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(

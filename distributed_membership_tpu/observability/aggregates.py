@@ -255,6 +255,43 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
     )
 
 
+def merge_agg(a, b):
+    """Merge two aggregate pytrees computed over DISJOINT tick ranges of
+    the same run (host-side, numpy) — the cross-segment accumulator of the
+    chunked/checkpointed sharded driver (runtime/checkpoint.py).
+
+    Every field has a clean merge because each is either a sum over ticks
+    (counts, histogram, totals), an or over ticks (observer flags), an
+    extremum (first/last removal tick, with the init values as
+    identities), or captured in exactly one segment (the fail-tick census
+    — zero everywhere else, so ``+`` is exact)."""
+    if isinstance(a, FastAgg):
+        return FastAgg(
+            det_count=np.add(a.det_count, b.det_count),
+            trackers=np.add(a.trackers, b.trackers),
+            tracker_obs=np.logical_or(a.tracker_obs, b.tracker_obs),
+            det_obs=np.logical_or(a.det_obs, b.det_obs),
+            lat_hist=np.add(a.lat_hist, b.lat_hist),
+            join_total=np.add(a.join_total, b.join_total),
+            rm_total=np.add(a.rm_total, b.rm_total),
+            sent_total=np.add(a.sent_total, b.sent_total),
+            recv_total=np.add(a.recv_total, b.recv_total),
+        )
+    return AggStats(
+        rm_count=np.add(a.rm_count, b.rm_count),
+        det_count=np.add(a.det_count, b.det_count),
+        rm_first=np.minimum(a.rm_first, b.rm_first),
+        rm_last=np.maximum(a.rm_last, b.rm_last),
+        join_count=np.add(a.join_count, b.join_count),
+        trackers=np.add(a.trackers, b.trackers),
+        tracker_obs=np.logical_or(a.tracker_obs, b.tracker_obs),
+        det_obs=np.logical_or(a.det_obs, b.det_obs),
+        lat_hist=np.add(a.lat_hist, b.lat_hist),
+        sent_total=np.add(a.sent_total, b.sent_total),
+        recv_total=np.add(a.recv_total, b.recv_total),
+    )
+
+
 def latency_stats(hist: np.ndarray) -> dict:
     """min/max/p50/p99/overflow/nonzero-bins view of a latency histogram
     (shared by detection_summary, fast_summary, and the phase sweep)."""

@@ -1,0 +1,21 @@
+"""Device-mesh parallelism helpers.
+
+Also the single compatibility seam for ``shard_map``: newer jax exports it
+as ``jax.shard_map`` (kwarg ``check_vma``), older releases (including this
+image's 0.4.x) only under ``jax.experimental.shard_map`` (same knob named
+``check_rep``) — importing from here keeps every backend and test working
+on both.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:  # pragma: no cover - which branch runs depends on jax
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)

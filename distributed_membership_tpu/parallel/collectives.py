@@ -38,7 +38,9 @@ def ring_reduce_scatter_max(x: jax.Array, axis_name: str) -> jax.Array:
     Returns:
       ``[B, ...]``: the max over all shards' partials of this shard's rows.
     """
-    s = lax.axis_size(axis_name)
+    # Static axis size: psum of a Python scalar constant-folds to an int
+    # on every supported jax (lax.axis_size only exists on newer releases).
+    s = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     if s == 1:
         return x

@@ -22,10 +22,28 @@ from distributed_membership_tpu.observability.metrics import write_msgcount
 
 
 def run_conf(conf_path: str, backend: str | None = None,
-             seed: int | None = None, out_dir: str = ".") -> RunResult:
+             seed: int | None = None, out_dir: str = ".",
+             checkpoint_every: int | None = None,
+             checkpoint_dir: str | None = None,
+             resume: bool | None = None) -> RunResult:
     params = Params.from_file(conf_path)
+    override = False
     if backend is not None:
         params.BACKEND = backend
+        override = True
+    # Crash-recovery knobs (runtime/checkpoint.py): CLI overrides win over
+    # the conf's CHECKPOINT_* / RESUME keys so an operator can resume a
+    # run whose conf predates the checkpoint keys.
+    if checkpoint_every is not None:
+        params.CHECKPOINT_EVERY = checkpoint_every
+        override = True
+    if checkpoint_dir is not None:
+        params.CHECKPOINT_DIR = checkpoint_dir
+        override = True
+    if resume is not None:
+        params.RESUME = int(resume)
+        override = True
+    if override:
         params.validate()
     result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
     result.log.flush(out_dir)
@@ -148,6 +166,19 @@ def main(argv=None) -> int:
                          "(default: ./testcases next to the repo root)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--out-dir", default=".")
+    ap.add_argument("--checkpoint-every", type=int, default=None,
+                    metavar="TICKS",
+                    help="run the tick loop in TICKS-sized scan segments, "
+                         "snapshotting the full carry between segments "
+                         "(CHECKPOINT_EVERY conf key; "
+                         "runtime/checkpoint.py)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="directory for checkpoint snapshots + manifest "
+                         "(CHECKPOINT_DIR conf key)")
+    ap.add_argument("--resume", action="store_true", default=None,
+                    help="resume bit-exactly from --checkpoint-dir's "
+                         "latest valid checkpoint (validated against this "
+                         "config/seed; starts fresh when none exists)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                     help="pin the jax platform (e.g. cpu for hermetic runs on "
                          "a virtual device mesh)")
@@ -171,7 +202,10 @@ def main(argv=None) -> int:
         resolve_platform(pin=args.platform)
 
     result = run_conf(args.conf, backend=args.backend, seed=args.seed,
-                      out_dir=args.out_dir)
+                      out_dir=args.out_dir,
+                      checkpoint_every=args.checkpoint_every,
+                      checkpoint_dir=args.checkpoint_dir,
+                      resume=args.resume)
 
     summary = {
         "backend": result.params.BACKEND,

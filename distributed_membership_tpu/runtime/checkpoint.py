@@ -1,0 +1,383 @@
+"""Checkpoint/resume: the resilient-run harness for the jitted backends.
+
+A 700-tick 1M-node run used to be one monolithic ``lax.scan`` that had to
+complete inside a single flaky hardware window or produce nothing (round 5:
+the TPU relay was dark all round and every ladder pass banked zero rungs).
+Production ML stacks on shared mesh hardware treat preemption as normal and
+checkpoint/restore as the baseline availability mechanism; this module is
+that mechanism for the simulator:
+
+  * :func:`chunked_run` drives a backend's tick loop in
+    ``CHECKPOINT_EVERY``-tick scan segments.  Between segments the full
+    carry (membership tensors, mailboxes, counters, event aggregates) is
+    pulled to host and — when ``CHECKPOINT_DIR`` is set — snapshotted to a
+    versioned on-disk checkpoint with atomic write-rename, plus a manifest
+    recording ``(params_text, seed, backend, tick, state_hash)``.  The
+    per-tick PRNG keys are re-derived from the run seed via
+    ``runtime/failures.plan_tensors`` (fold_in of the tick index), so only
+    the tick index needs persisting — never key material.
+  * ``RESUME: 1`` validates the manifest against the requested config and
+    continues the run **bit-exactly**: resumed dbg.log/stats.log and final
+    grades are identical to an uninterrupted run (pinned by
+    tests/test_checkpoint.py, which kills runs mid-flight at several ticks).
+  * With ``EVENT_MODE: full``, each segment's stacked event tensors are
+    flushed to a host-side compaction (:class:`CompactEvents`) immediately,
+    so device memory for events is O(CHECKPOINT_EVERY * N * M) instead of
+    the whole-run O(T * N * M) cliff (~350 GB at N=1M).
+
+Fault injection for tests and drills: set the ``DM_CRASH_AT_TICK`` env var
+to a tick index and the driver raises ``RuntimeError`` the moment it would
+start the segment containing that tick — leaving exactly the on-disk state
+a real mid-run kill leaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Callable, List, NamedTuple, Optional
+
+import numpy as np
+
+from distributed_membership_tpu.config import Params
+
+CKPT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+KEEP_CHECKPOINTS = 3       # versioned history depth; older files pruned
+CRASH_ENV = "DM_CRASH_AT_TICK"
+
+# Fields that do not change what the run computes per tick: the clock
+# (reset by parse), and the checkpoint-control keys themselves — a resume
+# may legitimately use a different CHECKPOINT_EVERY/DIR (segment boundaries
+# never affect per-tick math; bit-exactness is pinned across chunkings).
+_IDENTITY_EXCLUDE = frozenset(
+    {"globaltime", "dropmsg", "CHECKPOINT_EVERY", "CHECKPOINT_DIR",
+     "RESUME"})
+
+
+def params_identity(params: Params) -> str:
+    """Canonical text of every protocol-relevant config field — the
+    manifest's ``params_text``.  Two configs with equal identity compute
+    the same per-tick transition for the same seed."""
+    d = {k: v for k, v in dataclasses.asdict(params).items()
+         if k not in _IDENTITY_EXCLUDE}
+    return json.dumps(d, sort_keys=True)
+
+
+def state_hash(leaves) -> str:
+    """sha256 over the carry's flattened leaves (dtype, shape, bytes) —
+    detects on-disk corruption and wrong-file resumes before any compute."""
+    h = hashlib.sha256()
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------------------
+# Host-side event compaction (the EVENT_MODE=full per-segment flush)
+
+class CompactEvents(NamedTuple):
+    """Sparse host form of the full-event stacked tensors.
+
+    ``joins``/``removes`` rows are ``(tick, logger_index, member_index)``
+    (0-based, as the stacked tensors index them); ``sent``/``recv`` keep
+    the dense ``[T, N]`` msgcount shape (already O(T*N) in the reference's
+    own profile matrices).  ``events_to_log`` in backends/tpu.py and
+    backends/tpu_sparse.py consume this form directly.
+    """
+    joins: np.ndarray     # [K, 3] i64
+    removes: np.ndarray   # [R, 3] i64
+    sent: np.ndarray      # [T, N] i32
+    recv: np.ndarray      # [T, N] i32
+    total: int            # ticks covered
+
+
+def _triples(t, i, j, t0: int) -> np.ndarray:
+    out = np.stack([np.asarray(t, np.int64) + t0,
+                    np.asarray(i, np.int64),
+                    np.asarray(j, np.int64)], axis=1)
+    return out.reshape(-1, 3)
+
+
+def compact_dense(events, t0: int = 0) -> CompactEvents:
+    """Compact the dense backend's TickEvents ([C, N, N] bool planes)."""
+    jt, ji, jj = np.nonzero(np.asarray(events.joins))
+    rt, ri, rj = np.nonzero(np.asarray(events.removes))
+    sent = np.asarray(events.sent)
+    return CompactEvents(_triples(jt, ji, jj, t0), _triples(rt, ri, rj, t0),
+                         sent, np.asarray(events.recv), sent.shape[0])
+
+
+def compact_sparse(events, t0: int = 0) -> CompactEvents:
+    """Compact SparseTickEvents ([C, N, M] member-id planes, -1 = none)."""
+    join_ids = np.asarray(events.join_ids)
+    rm_ids = np.asarray(events.rm_ids)
+    jt, ji, js = np.nonzero(join_ids >= 0)
+    rt, ri, rs = np.nonzero(rm_ids >= 0)
+    sent = np.asarray(events.sent)
+    return CompactEvents(_triples(jt, ji, join_ids[jt, ji, js], t0),
+                         _triples(rt, ri, rm_ids[rt, ri, rs], t0),
+                         sent, np.asarray(events.recv), sent.shape[0])
+
+
+def concat_compact(parts: List[CompactEvents]) -> CompactEvents:
+    parts = [p for p in parts if p is not None]
+    if len(parts) == 1:
+        return parts[0]
+    return CompactEvents(
+        np.concatenate([p.joins for p in parts]),
+        np.concatenate([p.removes for p in parts]),
+        np.concatenate([p.sent for p in parts]),
+        np.concatenate([p.recv for p in parts]),
+        sum(p.total for p in parts))
+
+
+def _empty_compact(n: int) -> CompactEvents:
+    z3 = np.zeros((0, 3), np.int64)
+    zn = np.zeros((0, n), np.int32)
+    return CompactEvents(z3, z3.copy(), zn, zn.copy(), 0)
+
+
+# --------------------------------------------------------------------------
+# On-disk format
+
+def _manifest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, MANIFEST_NAME)
+
+
+def load_manifest(ckpt_dir: Optional[str]) -> Optional[dict]:
+    """The manifest dict, or None when absent/unreadable (a torn write is
+    a fresh start, never a crash — resume must not brick the retry loop)."""
+    if not ckpt_dir:
+        return None
+    try:
+        with open(_manifest_path(ckpt_dir)) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def manifest_tick(ckpt_dir: Optional[str]) -> Optional[int]:
+    """Latest durably-checkpointed tick (ladder/bench resume provenance)."""
+    m = load_manifest(ckpt_dir)
+    return None if m is None else int(m.get("tick", 0)) or None
+
+
+def _atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
+    tmp = path + ".tmp"
+    write_fn(tmp)
+    os.replace(tmp, path)
+
+
+def _manifest_base(params: Params, seed: int, total: int,
+                   collect_events: bool) -> dict:
+    return {
+        "version": CKPT_VERSION,
+        "params_text": params_identity(params),
+        "seed": int(seed),
+        "backend": params.BACKEND,
+        "total_time": int(total),
+        "collect_events": bool(collect_events),
+    }
+
+
+def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
+                     carry_leaves: list, payload: dict) -> None:
+    """One versioned snapshot: ``ckpt_<tick>.npz`` (atomic write-rename),
+    then the manifest pointing at it (atomic too — a crash between the
+    two leaves the previous manifest valid)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    fname = f"ckpt_{tick:08d}.npz"
+    arrays = {f"c{i}": np.asarray(leaf)
+              for i, leaf in enumerate(carry_leaves)}
+    arrays.update({f"e_{k}": np.asarray(v) for k, v in payload.items()})
+
+    def _write_npz(tmp):
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+
+    _atomic_write(os.path.join(ckpt_dir, fname), _write_npz)
+    shash = state_hash(carry_leaves)
+
+    prev = load_manifest(ckpt_dir)
+    history = []
+    if prev is not None and all(
+            prev.get(k) == base[k] for k in base):
+        history = [h for h in prev.get("checkpoints", ())
+                   if h["tick"] < tick]
+    history.append({"tick": int(tick), "file": fname, "state_hash": shash})
+    for stale in history[:-KEEP_CHECKPOINTS]:
+        try:
+            os.unlink(os.path.join(ckpt_dir, stale["file"]))
+        except OSError:
+            pass
+    history = history[-KEEP_CHECKPOINTS:]
+    manifest = dict(base)
+    manifest.update({
+        "tick": int(tick), "file": fname, "state_hash": shash,
+        "checkpoints": history,
+        "wrote_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    })
+    def _write_manifest(tmp):
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+
+    _atomic_write(_manifest_path(ckpt_dir), _write_manifest)
+
+
+def _load_for_resume(ckpt_dir: str, base: dict, template_leaves: list):
+    """→ (tick, carry_leaves, payload dict) from the latest valid
+    checkpoint, or None when no checkpoint exists.  A manifest that exists
+    but names a DIFFERENT run (config/seed/backend/length) raises — a
+    silent fresh start would quietly compute something other than what
+    the operator asked to resume."""
+    manifest = load_manifest(ckpt_dir)
+    if manifest is None:
+        return None
+    for k, want in base.items():
+        if manifest.get(k) != want:
+            raise ValueError(
+                f"RESUME manifest mismatch in {ckpt_dir!r}: field {k!r} "
+                f"was {manifest.get(k)!r}, this run wants {want!r} — "
+                "point CHECKPOINT_DIR elsewhere or clear it")
+    path = os.path.join(ckpt_dir, manifest["file"])
+    try:
+        npz = np.load(path)
+    except OSError as e:
+        raise ValueError(
+            f"RESUME: checkpoint file {path!r} named by the manifest is "
+            f"unreadable ({e})") from e
+    with npz as data:
+        leaves = []
+        for i, tmpl in enumerate(template_leaves):
+            key = f"c{i}"
+            if key not in data:
+                raise ValueError(
+                    f"RESUME: checkpoint {path!r} is missing carry leaf "
+                    f"{i} (truncated or from an incompatible code "
+                    "version)")
+            a = data[key]
+            t = np.asarray(tmpl)
+            if a.shape != t.shape or a.dtype != t.dtype:
+                raise ValueError(
+                    f"RESUME: carry leaf {i} shape/dtype mismatch "
+                    f"({a.shape}/{a.dtype} on disk vs "
+                    f"{t.shape}/{t.dtype}) — checkpoint is from a "
+                    "different config")
+            leaves.append(a)
+        payload = {k[len("e_"):]: data[k] for k in data.files
+                   if k.startswith("e_")}
+    got = state_hash(leaves)
+    if got != manifest["state_hash"]:
+        raise ValueError(
+            f"RESUME: state hash mismatch for {path!r} (manifest "
+            f"{manifest['state_hash'][:12]}…, file {got[:12]}…) — "
+            "checkpoint is corrupt")
+    return int(manifest["tick"]), leaves, payload
+
+
+# --------------------------------------------------------------------------
+# The chunked driver
+
+def _crash_tick() -> Optional[int]:
+    v = os.environ.get(CRASH_ENV)
+    return int(v) if v else None
+
+
+def chunked_run(params: Params, plan, seed: int, total: int, *,
+                init_carry, segment_fn, collect_events: bool,
+                compact_fn=None, event_type=None):
+    """Run the tick loop in ``CHECKPOINT_EVERY``-tick segments.
+
+    ``init_carry()`` builds the fresh device carry; ``segment_fn(carry,
+    ticks, keys, start_ticks, fail_mask, fail_time, drop_lo, drop_hi)``
+    is the backend's jitted scan over one segment (at most two segment
+    lengths compile: ``every`` and the final remainder).  Full-event runs
+    pass ``compact_fn`` (per-segment host flush into
+    :class:`CompactEvents`); aggregate runs pass ``event_type`` (the
+    per-tick outputs are scalars, concatenated field-wise).
+
+    Returns ``(final_carry, events)`` with ``events`` a
+    :class:`CompactEvents` (full mode) or ``event_type`` of ``[T]``
+    streams (aggregate mode) — bit-identical content to the monolithic
+    scan's.
+    """
+    import jax
+
+    from distributed_membership_tpu.runtime.failures import plan_tensors
+
+    every = params.CHECKPOINT_EVERY
+    if every <= 0:
+        raise ValueError("chunked_run requires CHECKPOINT_EVERY > 0")
+    if (compact_fn is None) == (event_type is None):
+        raise ValueError("pass exactly one of compact_fn/event_type")
+    ckpt_dir = params.CHECKPOINT_DIR or None
+
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
+    base = _manifest_base(params, seed, total, collect_events)
+
+    template = init_carry()
+    template_leaves, treedef = jax.tree_util.tree_flatten(template)
+
+    start = 0
+    carry = template
+    n = params.EN_GPSZ
+    if compact_fn is not None:
+        acc = _empty_compact(n)
+    else:
+        acc = None          # becomes a tuple of [t] arrays lazily
+
+    if params.RESUME and ckpt_dir:
+        loaded = _load_for_resume(ckpt_dir, base, template_leaves)
+        if loaded is not None:
+            start, leaves, payload = loaded
+            carry = jax.tree_util.tree_unflatten(treedef, leaves)
+            if compact_fn is not None:
+                acc = CompactEvents(
+                    payload["joins"], payload["removes"],
+                    payload["sent"], payload["recv"], start)
+            elif start > 0:
+                acc = tuple(payload[f"s{i}"] for i in range(4))
+
+    crash_at = _crash_tick()
+    for a in range(start, total, every):
+        if crash_at is not None and a >= crash_at:
+            raise RuntimeError(
+                f"injected crash at tick {a} ({CRASH_ENV}={crash_at}); "
+                f"last durable checkpoint: "
+                f"{manifest_tick(ckpt_dir) or 'none'}")
+        b = min(a + every, total)
+        carry, ev = segment_fn(carry, ticks[a:b], keys[a:b], start_ticks,
+                               fail_mask, fail_time, drop_lo, drop_hi)
+        # Per-segment flush: events leave the device NOW, so full-mode
+        # device memory is O(every * N * M), and the carry lands on host
+        # for the snapshot.
+        carry = jax.tree.map(np.asarray, carry)
+        ev = jax.tree.map(np.asarray, ev)
+        if compact_fn is not None:
+            acc = concat_compact([acc, compact_fn(ev, a)])
+            payload = {"joins": acc.joins, "removes": acc.removes,
+                       "sent": acc.sent, "recv": acc.recv}
+        else:
+            seg = tuple(np.asarray(x) for x in ev)
+            acc = (seg if acc is None else
+                   tuple(np.concatenate([p, s]) for p, s in zip(acc, seg)))
+            payload = {f"s{i}": acc[i] for i in range(4)}
+        if ckpt_dir:
+            _save_checkpoint(ckpt_dir, base,
+                             b, jax.tree_util.tree_leaves(carry), payload)
+
+    if compact_fn is not None:
+        events = acc
+    elif acc is None:        # zero-length run (total == start == 0)
+        events = event_type(*(np.zeros((0,), np.int32) for _ in range(4)))
+    else:
+        events = event_type(*acc)
+    return carry, events

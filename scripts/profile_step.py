@@ -50,7 +50,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
 
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
-    params = Params.from_text(
+    text = (
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
         f"FANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
@@ -59,17 +59,45 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
         f"PRNG_IMPL: {prng}\nSHIFT_SET: {shift_set}\n"
         f"BACKEND: tpu_hash\n")
+    params = Params.from_text(text)
     plan = make_plan(params, _pyrandom.Random("app:0"))
 
+    # Checkpointed mode (the ladder's interrupted-rung resume path,
+    # scripts/tpu_ladder.py): DM_CHECKPOINT_EVERY chunks both scans into
+    # segments; the WARMUP run persists/resumes via DM_CHECKPOINT_DIR +
+    # DM_RESUME, so a retried rung picks the compile-and-run back up at
+    # the last durable segment instead of restarting; the TIMED run chunks
+    # without persistence (the same compiled segment runners, no disk in
+    # the measured wall).
+    ck_every = int(os.environ.get("DM_CHECKPOINT_EVERY", "0") or 0)
+    ck_dir = os.environ.get("DM_CHECKPOINT_DIR", "")
+    resume = os.environ.get("DM_RESUME", "") not in ("", "0")
+    resumed_from = None
+    warm_params = timed_params = params
+    ckpt_fields = {}
+    if ck_every > 0:
+        from distributed_membership_tpu.runtime.checkpoint import (
+            manifest_tick)
+        do_resume = int(resume and bool(ck_dir))
+        warm_params = Params.from_text(
+            text + f"CHECKPOINT_EVERY: {ck_every}\n"
+            f"CHECKPOINT_DIR: {ck_dir}\nRESUME: {do_resume}\n")
+        timed_params = Params.from_text(
+            text + f"CHECKPOINT_EVERY: {ck_every}\n")
+        if do_resume:
+            resumed_from = manifest_tick(ck_dir)
+        ckpt_fields = {"checkpoint_every": ck_every,
+                       "resumed_from_tick": resumed_from}
+
     t0 = time.perf_counter()
-    final_state, _ = run_scan(params, plan, seed=0, collect_events=False,
-                              total_time=ticks)
+    final_state, _ = run_scan(warm_params, plan, seed=0,
+                              collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     compile_wall = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    final_state, _ = run_scan(params, plan, seed=1, collect_events=False,
-                              total_time=ticks)
+    final_state, _ = run_scan(timed_params, plan, seed=1,
+                              collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     wall = time.perf_counter() - t0
 
@@ -129,6 +157,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         "resident_state_mb": round(state_bytes / 1e6, 1),
         "est_model_gb_per_tick": round(est_gb_per_tick, 3),
         "implied_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
+        **ckpt_fields,
         **measured,
     }
 

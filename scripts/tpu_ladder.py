@@ -8,6 +8,12 @@ robust to that:
 
   * every rung runs in its OWN subprocess with a hard wall-clock timeout —
     a hung relay costs one rung, not the session;
+  * an interrupted rung (timeout or nonzero exit) retries up to
+    LADDER_RETRIES times with exponential backoff + jitter, and timing
+    rungs RESUME from their last durable checkpoint segment
+    (DM_CHECKPOINT_* env → profile_step.py → runtime/checkpoint.py)
+    instead of restarting; the banked record carries the
+    attempt/backoff/resume provenance;
   * rungs go smallest-first, so the cheapest evidence lands before the
     relay's next flake;
   * each completed rung appends to ``artifacts/TPU_PROFILE.json``
@@ -189,6 +195,53 @@ def probe() -> str | None:
     return probe_platform(timeout=90, retries=2)
 
 
+# Retry/backoff policy for interrupted rungs: a rung that dies or times
+# out (chip unavailability, relay flake) is retried up to MAX_ATTEMPTS
+# times with exponential backoff + jitter; timing rungs checkpoint their
+# scans (DM_CHECKPOINT_* → profile_step.py → runtime/checkpoint.py), so a
+# retry RESUMES from the last durable segment instead of restarting, and
+# the banked record carries the attempt/resume provenance.
+MAX_ATTEMPTS = int(os.environ.get("LADDER_RETRIES", "3"))
+BACKOFF_BASE_S = float(os.environ.get("LADDER_BACKOFF_BASE", "20"))
+BACKOFF_CAP_S = 300.0
+CKPT_ROOT = os.path.join(REPO, "artifacts", "ckpt")
+# Modes whose bit-exactness is pinned only on CPU (tests/test_shift_set.py
+# pins the lax.switch static-roll delivery against the dynamic path): the
+# banked record says so explicitly instead of riding the "no Pallas kernel
+# => ungated" exemption silently (ADVICE r5 #2).
+CPU_ONLY_PIN_MODES = {
+    "sw16": "cpu_only:tests/test_shift_set.py (lax.switch static-roll "
+            "delivery vs dynamic path; no on-chip equivalence run)",
+    "folded_sw16": "cpu_only:tests/test_shift_set.py+tests/test_folded.py",
+}
+
+
+def _backoff_delay(attempt: int) -> float:
+    """Exponential with jitter: 20s, 40s, 80s… capped, +0-25% random."""
+    import random
+    base = min(BACKOFF_BASE_S * (2 ** (attempt - 1)), BACKOFF_CAP_S)
+    return base * (1.0 + 0.25 * random.random())
+
+
+def _rung_ckpt_dir(name: str) -> str:
+    return os.path.join(CKPT_ROOT, name)
+
+
+def _attempt(name: str, cmd: list, timeout: float, env: dict):
+    """One subprocess attempt; returns (rec | None, interrupted: bool) —
+    interrupted distinguishes a timeout/crash (retryable, may resume)
+    from a deterministic non-timeout failure path already handled by the
+    caller."""
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print(f"  rung {name}: TIMED OUT after {timeout}s (relay flake?)",
+              flush=True)
+        return None, True
+    return r, False
+
+
 def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
              timeout: float) -> dict | None:
     env = dict(os.environ)
@@ -222,41 +275,86 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                "--shift-set",
                "16" if fused in ("sw16", "folded_sw16") else "0",
                "--prng", "rbg" if fused == "rbg" else "threefry2x32"]
-    try:
-        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
-                           text=True, env=env, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        print(f"  rung {name}: TIMED OUT after {timeout}s (relay flake?)",
-              flush=True)
-        return None
-    if r.returncode != 0:
-        if name in CORRECTNESS_ARMS:
-            # A deterministic fused-vs-jnp mismatch is EVIDENCE, not a relay
-            # flake: tpu_correctness.py exits 1 with the mismatch JSON on
-            # stdout.  Record it (so --loop doesn't retry forever) and let
-            # _missing() drop the fused rungs.
-            try:
-                rec = json.loads(r.stdout.strip().splitlines()[-1])
-                if rec.get("check") == "fused_vs_jnp_same_platform":
-                    print(f"  rung {name}: CORRECTNESS FAILURE — "
-                          f"{json.dumps(rec['mismatched_elements'])}",
-                          flush=True)
-                    rec["rung"] = name
-                    rec["timestamp"] = time.strftime(
-                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-                    return rec
-            except (json.JSONDecodeError, IndexError):
-                pass
-        tail = (r.stderr or "").strip().splitlines()[-40:]
-        print(f"  rung {name}: rc={r.returncode}\n    " + "\n    ".join(tail),
-              flush=True)
-        return None
-    try:
-        rec = json.loads(r.stdout.strip().splitlines()[-1])
-    except (json.JSONDecodeError, IndexError):
+    # Timing rungs (profile_step) checkpoint their scans so an interrupted
+    # attempt RESUMES from the last durable segment; the special-script
+    # rungs (correctness/layout/bisect) still get the retry/backoff loop,
+    # just without resume.
+    timing = not (name in CORRECTNESS_ARMS or name == LAYOUT_RUNG[0]
+                  or name.startswith("bisect_"))
+    ckpt_dir = _rung_ckpt_dir(name) if timing else None
+    attempt_log = []
+    rec = None
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        resumed_from = None
+        if ckpt_dir:
+            from distributed_membership_tpu.runtime.checkpoint import (
+                manifest_tick)
+            resumed_from = manifest_tick(ckpt_dir)
+            env["DM_CHECKPOINT_DIR"] = ckpt_dir
+            env["DM_CHECKPOINT_EVERY"] = str(max(10, ticks // 5))
+            env["DM_RESUME"] = "1"
+        attempt_log.append({"attempt": attempt,
+                            "resumed_from_tick": resumed_from})
+        r, timed_out = _attempt(name, cmd, timeout, env)
+        if not timed_out:
+            if r.returncode == 0:
+                try:
+                    rec = json.loads(r.stdout.strip().splitlines()[-1])
+                except (json.JSONDecodeError, IndexError):
+                    return None
+                break
+            if name in CORRECTNESS_ARMS:
+                # A deterministic fused-vs-jnp mismatch is EVIDENCE, not a
+                # relay flake: tpu_correctness.py exits 1 with the mismatch
+                # JSON on stdout.  Record it (so --loop doesn't retry
+                # forever) and let _missing() drop the fused rungs.
+                try:
+                    rec = json.loads(r.stdout.strip().splitlines()[-1])
+                    if rec.get("check") == "fused_vs_jnp_same_platform":
+                        print(f"  rung {name}: CORRECTNESS FAILURE — "
+                              f"{json.dumps(rec['mismatched_elements'])}",
+                              flush=True)
+                        rec["rung"] = name
+                        rec["timestamp"] = time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                        return rec
+                except (json.JSONDecodeError, IndexError):
+                    pass
+                rec = None
+            tail = (r.stderr or "").strip().splitlines()[-40:]
+            print(f"  rung {name}: rc={r.returncode}\n    "
+                  + "\n    ".join(tail), flush=True)
+        if attempt >= MAX_ATTEMPTS:
+            break
+        if probe() != "tpu":
+            # Relay gone: backoff-retrying against a dead relay burns the
+            # pass; the --loop daemon re-arms the rung next interval (its
+            # checkpoint survives, so the eventual retry still resumes).
+            print(f"  rung {name}: relay not serving — abandoning "
+                  "retries this pass", flush=True)
+            return None
+        delay = _backoff_delay(attempt)
+        attempt_log[-1]["backoff_s"] = round(delay, 1)
+        print(f"  rung {name}: attempt {attempt}/{MAX_ATTEMPTS} "
+              f"interrupted; backing off {delay:.0f}s then "
+              f"{'resuming' if ckpt_dir else 'retrying'}", flush=True)
+        time.sleep(delay)
+    if rec is None:
         return None
     rec["rung"] = name
     rec["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # Attempt/resume provenance: how many tries this evidence took and
+    # where each retry picked the scan back up.
+    rec["attempts"] = len(attempt_log)
+    if len(attempt_log) > 1 or attempt_log[-1]["resumed_from_tick"]:
+        rec["attempt_log"] = attempt_log
+    if fused in CPU_ONLY_PIN_MODES:
+        rec["bit_exactness_pin"] = CPU_ONLY_PIN_MODES[fused]
+    if ckpt_dir:
+        import shutil
+        # A completed rung's stale checkpoint would make a future re-run's
+        # warmup resume a finished scan (skipping the jit warm) — drop it.
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     return rec
 
 

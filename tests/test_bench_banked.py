@@ -101,6 +101,52 @@ def test_mode_normalization_and_derived_hbm(tmp_path):
     assert row["mode"] == "natural"
 
 
+def test_banked_displacement_requires_same_n_and_shift_set(tmp_path):
+    """A banked TPU row may displace a LIVE TPU headline only at the same
+    (n, shift_set) protocol point; +swK rows (restricted gossip graph)
+    and other-n rows stay labeled alternates (ADVICE r5 #1)."""
+    _write(tmp_path, "TPU_PROFILE.json", [
+        {"platform": "tpu", "rung": "1M_s16_sw16", "n": 1 << 20, "s": 16,
+         "ticks": 60, "wall_seconds": 6.0, "ticks_per_sec": 10.0,
+         "node_ticks_per_sec": 2.0e7, "fanout": 3, "probes": 2,
+         "exchange": "ring", "timing": "warm_cache",
+         "implied_hbm_gbps": 1.0, "shift_set": 16},
+    ])
+    banked = bench._best_banked_tpu(str(tmp_path))
+    assert banked["shift_set"] == 16 and banked["mode"].endswith("+sw16")
+
+    live = {"platform": "tpu", "n": 1 << 20, "shift_set": 0,
+            "node_ticks_per_sec": 1.0e7}
+    # Faster banked sw16 row vs default-protocol live: NOT displaced.
+    assert not bench._banked_displaces_live(banked, live)
+    # Same shift_set but different n: NOT displaced.
+    live_sw = dict(live, shift_set=16, n=1 << 16)
+    assert not bench._banked_displaces_live(banked, live_sw)
+    # Same (n, shift_set), faster: displaced; slower: not.
+    live_match = dict(live, shift_set=16)
+    assert bench._banked_displaces_live(banked, live_match)
+    assert not bench._banked_displaces_live(
+        banked, dict(live_match, node_ticks_per_sec=9.9e7))
+    # Legacy banked rows without the field count as shift_set 0.
+    _write(tmp_path, "TPU_PROFILE.json", [
+        {"platform": "tpu", "rung": "1M_s16", "n": 1 << 20, "s": 16,
+         "ticks": 60, "wall_seconds": 6.0, "ticks_per_sec": 10.0,
+         "node_ticks_per_sec": 2.0e7, "fanout": 3, "probes": 2,
+         "exchange": "ring", "timing": "warm_cache",
+         "implied_hbm_gbps": 1.0},
+    ])
+    legacy = bench._best_banked_tpu(str(tmp_path))
+    assert legacy["shift_set"] == 0
+    assert bench._banked_displaces_live(legacy, live)
+    # The match filter selects only same-(n, shift_set) candidates, so a
+    # faster ineligible row cannot shadow a slower eligible one.
+    assert bench._best_banked_tpu(str(tmp_path), match=live) is not None
+    assert bench._best_banked_tpu(
+        str(tmp_path), match=dict(live, n=1 << 16)) is None
+    assert bench._best_banked_tpu(
+        str(tmp_path), match=dict(live, shift_set=16)) is None
+
+
 def test_fused_mode_strings(tmp_path):
     for flags, want in [({"fused": True}, "fused:recv"),
                         ({"fused_gossip": True}, "fused:gossip"),

@@ -1,0 +1,327 @@
+"""Checkpoint/resume harness (runtime/checkpoint.py).
+
+Pins the resilient-run contract end to end:
+
+  * chunked execution (``CHECKPOINT_EVERY``) is bit-exact with the
+    monolithic whole-run scan — identical dbg.log bytes and grader
+    verdicts — on every chunked backend;
+  * a run killed mid-flight (``DM_CRASH_AT_TICK`` fault injection) leaves
+    a valid on-disk checkpoint and ``RESUME: 1`` continues it to a
+    byte-identical dbg.log/stats.log and identical grades, at several kill
+    ticks, under SINGLE_FAILURE=0 and DROP_MSG=1, and for single/multi/
+    rack failure plans with kills before FAIL_TIME and inside the
+    DROP_MSG window;
+  * the manifest validates (config/seed mismatch and corruption raise;
+    resume with no checkpoint starts fresh);
+  * the config gates reject unsupported backends/modes loudly.
+"""
+
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.grader import SCENARIO_GRADERS
+from distributed_membership_tpu.runtime import checkpoint as ck
+from distributed_membership_tpu.runtime.application import run_conf
+
+TESTDIR = pathlib.Path(__file__).resolve().parent.parent / "testcases"
+SEED = 3
+EVERY = 50
+
+
+def _run(scenario, backend, out_dir, **kw):
+    return run_conf(str(TESTDIR / f"{scenario}.conf"), backend=backend,
+                    seed=SEED, out_dir=str(out_dir), **kw)
+
+
+_REF = {}
+
+
+def _reference(scenario, backend, tmp_path_factory):
+    """Uninterrupted MONOLITHIC run (no chunking at all) — the comparator
+    every chunked/resumed run must match byte-for-byte."""
+    key = (scenario, backend)
+    if key not in _REF:
+        out = tmp_path_factory.mktemp(f"ref_{backend}_{scenario}")
+        r = _run(scenario, backend, out)
+        _REF[key] = (r.log.dbg_text(), r.log.stats_text(),
+                     r.sent.copy(), r.failed_indices)
+    return _REF[key]
+
+
+# Full cross product: both bounded-view backends, all three grading
+# scenarios (singlefailure; multifailure = SINGLE_FAILURE=0; msgdrop =
+# DROP_MSG=1), kills at {50, 150, 400}.  Kill 50 lands before
+# FAIL_TIME=100 (resume must re-derive the identical failure schedule);
+# kill 150 lands inside the [50, 300) drop window (resume must continue
+# the per-tick drop-coin streams bit-exactly).
+KILL_MATRIX = [
+    (backend, scenario, kill)
+    for backend in ("tpu_hash", "tpu_sparse")
+    for scenario in ("singlefailure", "multifailure",
+                     "msgdropsinglefailure")
+    for kill in (50, 150, 400)
+]
+
+
+@pytest.mark.parametrize("backend,scenario,kill", KILL_MATRIX)
+def test_kill_and_resume_bit_exact(backend, scenario, kill, tmp_path,
+                                   tmp_path_factory, monkeypatch):
+    ref_dbg, ref_stats, ref_sent, ref_failed = _reference(
+        scenario, backend, tmp_path_factory)
+    ckdir = tmp_path / "ckpt"
+
+    monkeypatch.setenv(ck.CRASH_ENV, str(kill))
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(scenario, backend, tmp_path / "crashed",
+             checkpoint_every=EVERY, checkpoint_dir=str(ckdir))
+    # The kill left durable state behind it (kill >= first boundary).
+    assert ck.manifest_tick(str(ckdir)) == (kill // EVERY) * EVERY
+
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r = _run(scenario, backend, tmp_path / "resumed",
+             checkpoint_every=EVERY, checkpoint_dir=str(ckdir),
+             resume=True)
+    assert r.log.dbg_text() == ref_dbg
+    assert r.log.stats_text() == ref_stats
+    assert np.array_equal(r.sent, ref_sent)
+    assert r.failed_indices == ref_failed
+    g_ref = SCENARIO_GRADERS[scenario](ref_dbg, r.params.EN_GPSZ)
+    g_res = SCENARIO_GRADERS[scenario](r.log.dbg_text(),
+                                       r.params.EN_GPSZ)
+    assert (g_res.points, g_res.passed) == (g_ref.points, g_ref.passed)
+
+
+@pytest.mark.quick
+def test_chunked_equals_monolithic_uninterrupted(tmp_path,
+                                                 tmp_path_factory):
+    """No kill at all: plain chunked execution matches the monolithic
+    scan byte-for-byte (the memory-bounding mode of EVENT_MODE=full)."""
+    ref_dbg, _, ref_sent, _ = _reference("singlefailure", "tpu_hash",
+                                         tmp_path_factory)
+    r = _run("singlefailure", "tpu_hash", tmp_path,
+             checkpoint_every=EVERY, checkpoint_dir=str(tmp_path / "ck"))
+    assert r.log.dbg_text() == ref_dbg
+    assert np.array_equal(r.sent, ref_sent)
+
+
+def test_dense_tpu_chunked_and_resumed(tmp_path, monkeypatch):
+    """The dense [N, N] backend chunks and resumes bit-exactly too."""
+    conf = tmp_path / "dense.conf"
+    conf.write_text("MAX_NNB: 10\nSINGLE_FAILURE: 0\nDROP_MSG: 1\n"
+                    "MSG_DROP_PROB: 0.1\nTOTAL_TIME: 160\n"
+                    "BACKEND: tpu\n")
+    r0 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "a"))
+    ckdir = tmp_path / "ck"
+    monkeypatch.setenv(ck.CRASH_ENV, "90")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "b"),
+                 checkpoint_every=30, checkpoint_dir=str(ckdir))
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r1 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "b"),
+                  checkpoint_every=30, checkpoint_dir=str(ckdir),
+                  resume=True)
+    assert r1.log.dbg_text() == r0.log.dbg_text()
+    assert np.array_equal(r1.recv, r0.recv)
+
+
+def test_rack_plan_resume_inside_drop_window(tmp_path, monkeypatch):
+    """Correlated rack failures + a kill before FAIL_TIME and inside the
+    drop window: the resumed run reproduces the identical failure
+    schedule (failed_indices + 'Node failed' lines) and dbg.log."""
+    text = ("MAX_NNB: 32\nSINGLE_FAILURE: 0\nDROP_MSG: 1\n"
+            "MSG_DROP_PROB: 0.1\nRACK_SIZE: 4\nRACK_FAILURES: 2\n"
+            "TOTAL_TIME: 120\nFAIL_TIME: 40\nDROP_START: 20\n"
+            "DROP_STOP: 80\nJOIN_MODE: warm\nEVENT_MODE: full\n"
+            "BACKEND: tpu_hash\n")
+    conf = tmp_path / "rack.conf"
+    conf.write_text(text)
+    r0 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "a"))
+    assert len(r0.failed_indices) == 8          # 2 racks of 4
+    ckdir = tmp_path / "ck"
+    monkeypatch.setenv(ck.CRASH_ENV, "30")      # < FAIL_TIME, in window
+    with pytest.raises(RuntimeError, match="injected crash"):
+        run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "b"),
+                 checkpoint_every=20, checkpoint_dir=str(ckdir))
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r1 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "b"),
+                  checkpoint_every=20, checkpoint_dir=str(ckdir),
+                  resume=True)
+    assert r1.failed_indices == r0.failed_indices
+    assert r1.log.dbg_text() == r0.log.dbg_text()
+
+
+def test_folded_layout_chunked_matches_monolithic(tmp_path):
+    """The FOLDED [N/F, 128] layout rides tpu_hash's chunked driver (same
+    run_scan seam): summary identical to the monolithic folded run."""
+    base = ("MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 80\n"
+            "FAIL_TIME: 30\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "EXCHANGE: ring\nFOLDED: 1\nBACKEND: tpu_hash\n")
+    r0 = get_backend("tpu_hash")(Params.from_text(base), seed=4)
+    r1 = get_backend("tpu_hash")(Params.from_text(
+        base + f"CHECKPOINT_EVERY: 30\nCHECKPOINT_DIR: {tmp_path}\n"),
+        seed=4)
+    assert (r1.extra["detection_summary"]
+            == r0.extra["detection_summary"])
+    assert np.array_equal(r1.sent, r0.sent)
+
+
+def test_sharded_chunked_agg_and_resume(tmp_path, monkeypatch):
+    """tpu_hash_sharded (virtual 8-device mesh): chunked aggregate-mode
+    runs — per-shard partials reduced per segment, merged host-side —
+    match the monolithic detection summary exactly, including across a
+    kill/resume."""
+    base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 90\n"
+            "FAIL_TIME: 30\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "BACKEND: tpu_hash_sharded\n")
+    r0 = get_backend("tpu_hash_sharded")(Params.from_text(base), seed=1)
+    ckdir = tmp_path / "ck"
+    ck_keys = (f"CHECKPOINT_EVERY: 25\nCHECKPOINT_DIR: {ckdir}\n")
+    monkeypatch.setenv(ck.CRASH_ENV, "60")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_backend("tpu_hash_sharded")(
+            Params.from_text(base + ck_keys), seed=1)
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r1 = get_backend("tpu_hash_sharded")(
+        Params.from_text(base + ck_keys + "RESUME: 1\n"), seed=1)
+    assert (r1.extra["detection_summary"]
+            == r0.extra["detection_summary"])
+    assert np.array_equal(r1.sent, r0.sent)
+
+
+def test_sharded_chunked_full_events(tmp_path):
+    base = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nTOTAL_TIME: 80\nFAIL_TIME: 30\n"
+            "BACKEND: tpu_hash_sharded\n")
+    r0 = get_backend("tpu_hash_sharded")(Params.from_text(base), seed=2)
+    r1 = get_backend("tpu_hash_sharded")(Params.from_text(
+        base + f"CHECKPOINT_EVERY: 30\nCHECKPOINT_DIR: {tmp_path}\n"),
+        seed=2)
+    assert r1.log.dbg_text() == r0.log.dbg_text()
+
+
+# ---------------------------------------------------------------------------
+# Manifest validation / on-disk robustness
+
+
+def _make_checkpoint(tmp_path, **conf_overrides):
+    conf = tmp_path / "c.conf"
+    conf.write_text("MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+                    "MSG_DROP_PROB: 0.1\nTOTAL_TIME: 100\n"
+                    "BACKEND: tpu_sparse\n")
+    ckdir = tmp_path / "ck"
+    run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "o"),
+             checkpoint_every=40, checkpoint_dir=str(ckdir))
+    return conf, ckdir
+
+
+@pytest.mark.quick
+def test_resume_rejects_mismatched_config_seed_and_corruption(tmp_path):
+    conf, ckdir = _make_checkpoint(tmp_path)
+    # Different seed → loud mismatch, not a silently different run.
+    with pytest.raises(ValueError, match="manifest mismatch.*seed"):
+        run_conf(str(conf), seed=SEED + 1, out_dir=str(tmp_path / "o2"),
+                 checkpoint_every=40, checkpoint_dir=str(ckdir),
+                 resume=True)
+    # Different protocol config → same.
+    conf2 = tmp_path / "c2.conf"
+    conf2.write_text(conf.read_text().replace("TOTAL_TIME: 100",
+                                              "TOTAL_TIME: 100\nTFAIL: 6"))
+    with pytest.raises(ValueError, match="manifest mismatch"):
+        run_conf(str(conf2), seed=SEED, out_dir=str(tmp_path / "o3"),
+                 checkpoint_every=40, checkpoint_dir=str(ckdir),
+                 resume=True)
+    # Corrupted state → hash mismatch.
+    man = json.loads((ckdir / ck.MANIFEST_NAME).read_text())
+    man["state_hash"] = "0" * 64
+    (ckdir / ck.MANIFEST_NAME).write_text(json.dumps(man))
+    with pytest.raises(ValueError, match="state hash mismatch"):
+        run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "o4"),
+                 checkpoint_every=40, checkpoint_dir=str(ckdir),
+                 resume=True)
+
+
+@pytest.mark.quick
+def test_resume_with_no_checkpoint_starts_fresh(tmp_path):
+    """RESUME: 1 with an empty dir runs from tick 0 (retry loops always
+    pass RESUME), and a torn manifest is treated as absent."""
+    conf = tmp_path / "c.conf"
+    conf.write_text("MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+                    "MSG_DROP_PROB: 0.1\nTOTAL_TIME: 80\n"
+                    "BACKEND: tpu_sparse\n")
+    r0 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "a"))
+    ckdir = tmp_path / "ck"
+    r1 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "b"),
+                  checkpoint_every=40, checkpoint_dir=str(ckdir),
+                  resume=True)
+    assert r1.log.dbg_text() == r0.log.dbg_text()
+    (ckdir / ck.MANIFEST_NAME).write_text("{torn")
+    assert ck.load_manifest(str(ckdir)) is None
+    r2 = run_conf(str(conf), seed=SEED, out_dir=str(tmp_path / "c"),
+                  checkpoint_every=40, checkpoint_dir=str(ckdir),
+                  resume=True)
+    assert r2.log.dbg_text() == r0.log.dbg_text()
+
+
+@pytest.mark.quick
+def test_versioned_history_pruned_and_atomic_names(tmp_path):
+    _, ckdir = _make_checkpoint(tmp_path)
+    files = sorted(p.name for p in ckdir.glob("ckpt_*.npz"))
+    assert len(files) == ck.KEEP_CHECKPOINTS
+    man = json.loads((ckdir / ck.MANIFEST_NAME).read_text())
+    assert [h["file"] for h in man["checkpoints"]] == files
+    assert man["file"] == files[-1]
+    assert man["tick"] == 100
+    assert not list(ckdir.glob("*.tmp"))        # no torn temp files left
+
+
+@pytest.mark.quick
+def test_config_gates():
+    base = ("MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0.1\n")
+    with pytest.raises(ValueError, match="not supported by BACKEND"):
+        Params.from_text(base + "BACKEND: emul\nCHECKPOINT_EVERY: 50\n")
+    with pytest.raises(ValueError, match="RESUME"):
+        Params.from_text(base + "BACKEND: tpu\nRESUME: 1\n")
+    with pytest.raises(ValueError, match="approx_lag"):
+        Params.from_text(
+            base + "BACKEND: tpu_hash\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
+            "PROBES: 2\nTFAIL: 16\nTREMOVE: 64\nJOIN_MODE: warm\n"
+            "EXCHANGE: ring\nPROBE_IO: approx_lag\n"
+            "CHECKPOINT_EVERY: 50\n")
+    with pytest.raises(ValueError, match="CHECKPOINT_EVERY"):
+        Params.from_text(base + "BACKEND: tpu\nCHECKPOINT_EVERY: -1\n")
+    # Identity excludes the checkpoint knobs themselves: resuming with a
+    # different segment length is legal (boundaries don't change math).
+    p1 = Params.from_text(base + "BACKEND: tpu\nCHECKPOINT_EVERY: 50\n")
+    p2 = Params.from_text(base + "BACKEND: tpu\nCHECKPOINT_EVERY: 25\n")
+    assert ck.params_identity(p1) == ck.params_identity(p2)
+
+
+@pytest.mark.quick
+def test_compact_events_roundtrip():
+    """compact_sparse/compact_dense produce the same (tick, logger,
+    member) inventory the stacked-tensor scans of events_to_log read."""
+    class Sparse:
+        join_ids = np.full((3, 2, 4), -1, np.int32)
+        rm_ids = np.full((3, 2, 4), -1, np.int32)
+        sent = np.arange(6, dtype=np.int32).reshape(3, 2)
+        recv = np.zeros((3, 2), np.int32)
+    Sparse.join_ids[1, 0, 2] = 7
+    Sparse.rm_ids[2, 1, 0] = 5
+    c = ck.compact_sparse(Sparse, t0=10)
+    assert c.joins.tolist() == [[11, 0, 7]]
+    assert c.removes.tolist() == [[12, 1, 5]]
+    assert c.total == 3
+    merged = ck.concat_compact([c, ck.compact_sparse(Sparse, t0=13)])
+    assert merged.total == 6 and merged.joins.tolist() == [[11, 0, 7],
+                                                           [14, 0, 7]]

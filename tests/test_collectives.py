@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from distributed_membership_tpu.parallel import shard_map
 
 from distributed_membership_tpu.parallel.collectives import (
     all_gather_vec, allreduce_max, reduce_scatter_sum, ring_reduce_scatter_max)

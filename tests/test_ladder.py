@@ -11,6 +11,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 
 def _load_ladder(tmp_path):
     spec = importlib.util.spec_from_file_location(
@@ -154,6 +156,82 @@ def test_partial_correctness_arms_fail_closed_and_accumulate(tmp_path):
     rungs = {r[0]: r[4] for r in lad2._missing()}
     assert "1M_s16_folded_fboth" not in rungs
     assert "1M_s16_folded" in rungs
+
+
+class _FakeProc:
+    returncode = 0
+    stderr = ""
+    stdout = json.dumps({"platform": "tpu", "node_ticks_per_sec": 5.0,
+                         "ms_per_tick": 1.0})
+
+
+def test_interrupted_rung_retries_resumes_and_banks_provenance(
+        tmp_path, monkeypatch):
+    """A simulated mid-rung interruption (attempt 1 times out) must yield
+    a RESUMED rung — retried after exponential backoff, child told to
+    resume from the rung's checkpoint — with attempt/backoff/resume
+    provenance in the banked record, not a restarted or silently dropped
+    rung."""
+    lad = _load_ladder(tmp_path)
+    monkeypatch.setattr(lad, "CKPT_ROOT", str(tmp_path / "ckpt"))
+    monkeypatch.setattr(lad, "probe", lambda: "tpu")
+    sleeps = []
+    monkeypatch.setattr(lad.time, "sleep", sleeps.append)
+    # A durable checkpoint from the interrupted attempt: tick 40 banked.
+    ckdir = tmp_path / "ckpt" / "65k_s64"
+    os.makedirs(ckdir)
+    with open(ckdir / "MANIFEST.json", "w") as fh:
+        json.dump({"tick": 40}, fh)
+
+    envs = []
+
+    def fake_attempt(name, cmd, timeout, env):
+        envs.append(dict(env))
+        if len(envs) == 1:
+            return None, True          # attempt 1: timeout (relay flake)
+        return _FakeProc(), False      # attempt 2: lands
+
+    monkeypatch.setattr(lad, "_attempt", fake_attempt)
+    rec = lad.run_rung("65k_s64", 1 << 16, 64, 150, "off", 10.0)
+    assert rec is not None and rec["attempts"] == 2
+    log = rec["attempt_log"]
+    assert log[0]["backoff_s"] > 0                 # backed off, not hot
+    assert sleeps and sleeps[0] == pytest.approx(log[0]["backoff_s"],
+                                                 rel=0.01)
+    assert log[1]["resumed_from_tick"] == 40       # resumed, not restarted
+    assert envs[1]["DM_RESUME"] == "1"
+    assert envs[1]["DM_CHECKPOINT_DIR"] == str(ckdir)
+    assert int(envs[1]["DM_CHECKPOINT_EVERY"]) > 0
+    # Success cleans the rung's checkpoint (a stale complete manifest
+    # would void a future re-run's warmup).
+    assert not os.path.exists(ckdir)
+
+
+def test_relay_down_mid_retry_abandons_pass_keeps_checkpoint(
+        tmp_path, monkeypatch):
+    lad = _load_ladder(tmp_path)
+    monkeypatch.setattr(lad, "CKPT_ROOT", str(tmp_path / "ckpt"))
+    monkeypatch.setattr(lad, "probe", lambda: None)      # relay gone
+    monkeypatch.setattr(lad, "_attempt",
+                        lambda *a: (None, True))
+    monkeypatch.setattr(lad.time, "sleep",
+                        lambda s: pytest.fail("must not backoff-wait "
+                                              "against a dead relay"))
+    assert lad.run_rung("65k_s64", 1 << 16, 64, 150, "off", 10.0) is None
+
+
+def test_sw16_rung_banks_cpu_only_correctness_pin(tmp_path, monkeypatch):
+    """sw16 rungs are exempt from the Pallas hardware gate (no kernel in
+    the program) but their bit-exactness is pinned only on CPU — the
+    banked record must say so explicitly (ADVICE r5 #2)."""
+    lad = _load_ladder(tmp_path)
+    monkeypatch.setattr(lad, "CKPT_ROOT", str(tmp_path / "ckpt"))
+    monkeypatch.setattr(lad, "_attempt",
+                        lambda *a: (_FakeProc(), False))
+    rec = lad.run_rung("65k_s16_sw16", 1 << 16, 16, 150, "sw16", 10.0)
+    assert rec["bit_exactness_pin"].startswith("cpu_only")
+    rec = lad.run_rung("65k_s64", 1 << 16, 64, 150, "off", 10.0)
+    assert "bit_exactness_pin" not in rec
 
 
 def test_later_arm_overrides_stale_failure_flag(tmp_path):

@@ -120,7 +120,7 @@ def test_block_send_unit_every_shift():   # ~8 s: full-tier
     shard (s + b) mod 8 — i.e. it equals a flat roll of the
     shard-indexed payload vector."""
     import jax.numpy as jnp
-    from jax import shard_map
+    from distributed_membership_tpu.parallel import shard_map
     from jax.sharding import PartitionSpec as P
 
     from distributed_membership_tpu.backends.tpu_hash_sharded import (

@@ -76,6 +76,20 @@ def test_shift_table_connected_and_in_range():
         assert tab[0] == 1          # ring cycle => connected gossip graph
 
 
+@pytest.mark.quick
+def test_shift_table_entries_distinct():
+    """The advertised K-way shift diversity: all K entries distinct (the
+    uniform draw over the table is only uniform over shifts if so).  The
+    function itself asserts this (ADVICE r5 #3); re-check here across the
+    config-reachable K range and awkward n so a relaxed constant/formula
+    cannot slip through with the assert removed."""
+    for n in (65, 256, 1 << 16, (1 << 20) - 3):
+        for k in (2, 16, 64):
+            if k < n:
+                tab = shift_table(n, k)
+                assert len(set(tab)) == k, (n, k, tab)
+
+
 def _scale_run(extra, n=4096, seed=0):
     p = Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
