@@ -29,7 +29,10 @@ Prints exactly one JSON line:
 Env overrides: BENCH_N / BENCH_TICKS / BENCH_VIEW (hash leg; gossip len and
 probes derive from the view size), BENCH_FUSED (off|recv|gossip|both —
 Pallas kernels), BENCH_FOLDED (on = the [N/F, 128] folded layout for
-S < 128), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg seconds).
+S < 128), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg seconds),
+BENCH_CHECKPOINT=K (+ BENCH_CHECKPOINT_COMPRESS=1) re-times the leg
+chunked with async-written snapshots, BENCH_RNG=1 adds the
+batched-vs-scattered threefry micro (ops/rng_plan) at the leg geometry.
 """
 
 from __future__ import annotations
@@ -60,6 +63,47 @@ def _timed_runs(run_scan, params, plan, ticks):
                               total_time=ticks)
     jax.block_until_ready(final_state)
     return time.perf_counter() - t0, final_state
+
+
+def _bench_rng_micro(cfg) -> dict:
+    """BENCH_RNG=1: price the per-tick ring RNG plan both ways at this
+    leg's geometry — the scattered per-site threefry draws vs the ONE
+    batched vmapped invocation (ops/rng_plan.hash_ring_rng) — with the
+    msgdrop-class coin streams armed (use_drop=True), since those are
+    the streams the batching collapses.  CPU numbers land in PERF.md;
+    the ladder rungs (1M_s16_rngplan / 1M_s16_onegather) price the same
+    lowering on-chip."""
+    import time as _t
+
+    import jax
+
+    from distributed_membership_tpu.ops.rng_plan import hash_ring_rng
+
+    def make(batched):
+        def f(key):
+            return hash_ring_rng(
+                key, n=cfg.n, s=cfg.s, g=cfg.g,
+                k_max=min(cfg.fanout, cfg.s), p_cnt=max(cfg.probes, 0),
+                seed_rows=min(cfg.seed_cap, cfg.n),
+                shift_set=cfg.shift_set, use_drop=True, need_ctrl=True,
+                need_burst=True, batched=batched)
+        return jax.jit(f)
+
+    key = jax.random.PRNGKey(0)
+    out = {}
+    for name, fn in (("scattered", make(False)), ("batched", make(True))):
+        r = fn(key)
+        jax.block_until_ready(r)
+        t0 = _t.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            r = fn(key)
+        jax.block_until_ready(r)
+        out[f"rng_{name}_ms"] = round(
+            1000 * (_t.perf_counter() - t0) / reps, 3)
+    out["rng_batched_speedup"] = round(
+        out["rng_scattered_ms"] / max(out["rng_batched_ms"], 1e-9), 2)
+    return out
 
 
 def _mode_str(frecv, fgossip, folded) -> str:
@@ -150,20 +194,30 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         import glob
         import tempfile
 
+        # BENCH_CHECKPOINT_COMPRESS=1 prices the savez_compressed knob
+        # on top (the write rides the background writer thread either
+        # way — runtime/checkpoint.py double-buffers it).
+        compress = os.environ.get("BENCH_CHECKPOINT_COMPRESS",
+                                  "0") not in ("", "0")
         with tempfile.TemporaryDirectory() as ckdir:
             params_ck = Params.from_text(
                 params_text + f"CHECKPOINT_EVERY: {ckpt_every}\n"
-                f"CHECKPOINT_DIR: {ckdir}\n")
+                f"CHECKPOINT_DIR: {ckdir}\n"
+                f"CHECKPOINT_COMPRESS: {int(compress)}\n")
             ck_wall, _ = _timed_runs(run_scan, params_ck, plan, ticks)
             kept = glob.glob(os.path.join(ckdir, "ckpt_*.npz"))
             ck_bytes = sum(os.path.getsize(p) for p in kept)
         ckpt_fields = {
             "checkpoint_every": ckpt_every,
+            "checkpoint_compress": int(compress),
             "checkpoint_wall_seconds": round(ck_wall, 3),
             "checkpoint_overhead_pct": round(100 * (ck_wall - wall)
                                              / max(wall, 1e-9), 1),
             "checkpoint_bytes_per_snapshot": ck_bytes // max(len(kept), 1),
         }
+    if os.environ.get("BENCH_RNG", "0") not in ("", "0"):
+        ckpt_fields.update(_bench_rng_micro(
+            make_config(params, collect_events=False)))
 
     # Approximate HBM traffic: full passes over the resident state per tick.
     # scatter: view+ts+mail+amail [N,S] u32 + pmail [N,Qp], reads+writes.

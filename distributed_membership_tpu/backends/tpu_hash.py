@@ -95,6 +95,7 @@ from distributed_membership_tpu.ops.fused_gossip import (
     gossip_fused, gossip_fused_stacked, gossip_fused_supported)
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
+from distributed_membership_tpu.ops.rng_plan import RingRng, hash_ring_rng
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import (
     EMPTY, STRIDE, hash_slot)
@@ -220,6 +221,26 @@ def _gathered_act(packed):
     return (packed & 2) != 0    # bit test stays valid if the pack widens
 
 
+def _pack_probe_table(hb, wf, act):
+    """Widen :func:`_pack_probe_bits` into the full packed probe table:
+    the ack-value heartbeat rides the HIGH 30 bits over the same two
+    filter bits, so ack value + will-flush + act + counter bits travel
+    ONE u32 per-target gather (PROBE_GATHER packed) instead of the two
+    [N, P] random gathers the 1M_s16 census flagged.  Headroom: hb must
+    fit 30 bits — implied by validate_sparse_packing's uint32 view-pack
+    bound whenever N >= 4 (hb_max * N < 2^32), which is why make_config
+    normalizes PROBE_GATHER to 'split' below that size.  The low-bit
+    layout is _pack_probe_bits', so _gathered_flush/_gathered_act apply
+    to this table's gathers unchanged."""
+    return ((hb.astype(U32) << 2)
+            | _pack_probe_bits(wf, act).astype(U32))
+
+
+def _gathered_hb(packed):
+    """The ack-value heartbeat back out of a _pack_probe_table gather."""
+    return (packed >> 2).astype(I32)
+
+
 def _credit_orphan_recvs(per_prober, will_flush):
     """Approx probe-recv attribution, single chip: keep rows that will
     flush; recvs counted for a non-flushing prober (already dead — its
@@ -316,6 +337,20 @@ class HashConfig:
     #                              lax.switch over static-roll branches
     #                              (the node-minor dynamic-roll
     #                              mitigation — config.py SHIFT_SET)
+    rng_mode: str = "batched"    # ring-path RNG lowering (config.py
+    #                              RNG_MODE; ops/rng_plan.py): 'scattered'
+    #                              one threefry per draw site, 'batched'
+    #                              same-size draws in ONE vmapped
+    #                              invocation, 'hoisted' batched + a
+    #                              whole segment pre-drawn outside the
+    #                              scan (chunked runs only).  Bit-exact
+    #                              streams in every mode.
+    probe_gather: str = "packed"  # ring probe/ack pipeline lowering
+    #                              (config.py PROBE_GATHER): 'packed'
+    #                              rides ack value + counter bits on ONE
+    #                              per-target gather via
+    #                              _pack_probe_table; 'split' keeps the
+    #                              pre-round-6 two-gather form (A/B arm)
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -428,6 +463,28 @@ def init_state_warm(cfg: HashConfig, key: jax.Array) -> HashState:
     )
 
 
+def _ring_rng_builder(cfg: HashConfig, use_drop: bool):
+    """``fn(tick_key) -> RingRng`` for this config's ring step (natural
+    or folded) — the SINGLE source both the inline per-tick draw and the
+    hoisted segment pre-draw build from, so the two cannot drift (the
+    hoisted [K, ...] tensors are exactly ``vmap(fn)(keys)``).  The
+    folded step never consumes the (trajectory-inert under warm join)
+    control/burst coins, so they are not drawn for it — natural keeps
+    them, matching the scattered step's draw set exactly."""
+    k_max = min(cfg.fanout, cfg.s)
+
+    def build(key):
+        return hash_ring_rng(
+            key, n=cfg.n, s=cfg.s, g=cfg.g, k_max=k_max,
+            p_cnt=max(cfg.probes, 0),
+            seed_rows=min(cfg.seed_cap, cfg.n),
+            shift_set=cfg.shift_set, use_drop=use_drop,
+            need_ctrl=not cfg.folded, need_burst=not cfg.folded,
+            batched=cfg.rng_mode != "scattered")
+
+    return build
+
+
 def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
     """Per-tick transition; same pass structure as the dense backend
     (backends/tpu.py) with hashed coordinates.  Pure/jittable.
@@ -504,17 +561,27 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         cfg, idx, idx)[:, None]                                   # [N, S]
     use_drop = dynamic_knobs or cfg.drop_prob > 0.0
 
+    rng_build = _ring_rng_builder(cfg, use_drop) if ring else None
+
     def step(state: HashState, inputs, fanout=None, drop_prob=None):
         t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
-        (k_targets, k_entries, k_drop, k_ctrl, k_drop_p, k_shifts,
-         k_ack1, k_ack2) = jax.random.split(key, 8)
         fanout_eff = cfg.fanout if fanout is None else fanout
         p_drop = cfg.drop_prob if drop_prob is None else drop_prob
+        if ring:
+            # All ring random streams come from the per-tick RNG plan
+            # (ops/rng_plan.py — same keys and bits as the scattered
+            # per-site draws; RNG_MODE selects the threefry lowering).
+            # Hoisted segments pass the pre-drawn plan in the key slot.
+            rng = key if isinstance(key, RingRng) else rng_build(key)
+        else:
+            (k_targets, k_entries, k_drop, k_ctrl, k_drop_p, k_shifts,
+             k_ack1, k_ack2) = jax.random.split(key, 8)
 
         drop_active = (t > drop_lo) & (t <= drop_hi)
         if use_drop:
-            ctrl_kept = ~(jax.random.bernoulli(k_ctrl, p_drop, (2, n))
-                          & drop_active)
+            ctrl_u = (rng.ctrl_u.reshape(2, n) if ring
+                      else jax.random.uniform(k_ctrl, (2, n)))
+            ctrl_kept = ~((ctrl_u < p_drop) & drop_active)
         else:
             ctrl_kept = jnp.ones((2, n), bool)
 
@@ -590,49 +657,11 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # Ring admit/ack/self/sweep run as ONE fused receive pass
             # (ops/fused_receive: receive_core, or its Pallas twin when
             # cfg.fused_receive) — below, after the vector control plane
-            # resolves act/self_on.  Here: ack candidates only.
+            # resolves act/self_on.  The ack-candidate gather also moved
+            # down next to that call: the packed probe table wants THIS
+            # tick's act/will_flush so the counter bits ride the same
+            # gather (PROBE_GATHER packed).
             amail, pmail = state.amail, state.pmail
-            ack_recv_cnt = jnp.zeros((n,), I32)
-            cand_full = jnp.zeros((n, s), U32)
-            if cfg.probes > 0:
-                # Acks for probes issued at t-2 (gather pipeline, see
-                # docstring).  vec[id] = the hb the target acked at t-1
-                # (self_hb at start of t-1, +1 — the mid-increment value
-                # the scatter path's own_hb carries), 0 if it wasn't act.
-                p_cnt = cfg.probes
-                ids2 = state.probe_ids2
-                id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-                vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-                if cfg.probe_io_lag:
-                    # approx_lag: the counter filter bits (t-1 snapshots,
-                    # _pack_probe_bits) ride the ack-value gather — ONE
-                    # [N, 2]-wide per-target random gather per tick.
-                    tbl2 = jnp.stack(
-                        [vec, _pack_probe_bits(state.wf_prev,
-                                               state.act_prev)], axis=1)
-                    g2 = tbl2[id2]                  # [N, P, 2] one gather
-                    hb_ack = g2[..., 0]
-                    lag_bits = g2[..., 1]
-                else:
-                    hb_ack = vec[id2]                      # [N, P] gather
-                valid2 = (ids2 > 0) & (hb_ack > 0)
-                # Probe-leg drops applied at issue time (probe block below,
-                # one coin shared by both redundant copies, as in scatter
-                # mode); only the ack leg's coin applies here.
-                if use_drop:
-                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                    valid2 &= ~(jax.random.bernoulli(k_ack2, p_drop,
-                                                     ids2.shape) & da_ack)
-                cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
-                ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
-                cand_full = jnp.concatenate(
-                    [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
-                # ptr2 only takes multiples of gcd(P, S): static-roll
-                # switch instead of a full-plane dynamic lane roll.
-                cand_full = ptr_switch(
-                    ptr2, p_cnt, s,
-                    lambda o, c: jnp.roll(c, o, axis=1), cand_full)
-                ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -697,6 +726,73 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             present = present & ~removes
             size = present.sum(1, dtype=I32)
         else:
+            ack_recv_cnt = jnp.zeros((n,), I32)
+            cand_full = jnp.zeros((n, s), U32)
+            will_flush = probe_bits1 = lag_bits = None
+            if cfg.probes > 0:
+                # Acks for probes issued at t-2 (gather pipeline, see
+                # docstring).  vec[id] = the hb the target acked at t-1
+                # (self_hb at start of t-1, +1 — the mid-increment value
+                # the scatter path's own_hb carries), 0 if it wasn't act.
+                p_cnt = cfg.probes
+                ids2 = state.probe_ids2
+                id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+                vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
+                ids1 = state.probe_ids1
+                v1 = ids1 > 0
+                tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+                # 'packed' (default): ack value + will-flush + act +
+                # counter bits ride ONE per-target gather per tick
+                # (_pack_probe_table) — the [N, 2P] index tensor is the
+                # t-2 ack indices and the t-1 counter indices
+                # concatenated.  n >= 4 guards the 30-bit hb headroom
+                # (see _pack_probe_table); PROBE_IO none draws no
+                # counter bits in either arm.
+                packed = cfg.probe_gather == "packed" and n >= 4
+                if cfg.probe_io_lag and packed:
+                    # approx_lag: the [N, P, 2] stacked gather collapses
+                    # to one packed-u32 [N, P] gather (t-1 snapshots of
+                    # the filter bits under the lagged heartbeat).
+                    g2 = _pack_probe_table(vec, state.wf_prev,
+                                           state.act_prev)[id2]
+                    hb_ack = _gathered_hb(g2)
+                    lag_bits = g2
+                elif cfg.probe_io_lag:
+                    # split arm (the pre-round-6 lowering): counter bits
+                    # ride the ack-value gather as a 2-wide last axis.
+                    tbl2 = jnp.stack(
+                        [vec, _pack_probe_bits(state.wf_prev,
+                                               state.act_prev)], axis=1)
+                    g2 = tbl2[id2]                  # [N, P, 2] one gather
+                    hb_ack = g2[..., 0]
+                    lag_bits = g2[..., 1]
+                elif packed and not cfg.probe_io_none:
+                    will_flush = _will_flush(recv_mask, fail_mask, t,
+                                             fail_time)
+                    tbl = _pack_probe_table(vec, will_flush, act)
+                    gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
+                    hb_ack = _gathered_hb(gcat[:, :p_cnt])
+                    probe_bits1 = gcat[:, p_cnt:]
+                else:
+                    hb_ack = vec[id2]                      # [N, P] gather
+                valid2 = (ids2 > 0) & (hb_ack > 0)
+                # Probe-leg drops applied at issue time (probe block below,
+                # one coin shared by both redundant copies, as in scatter
+                # mode); only the ack leg's coin applies here.
+                if use_drop:
+                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                    valid2 &= ~((rng.ack_u.reshape(ids2.shape) < p_drop)
+                                & da_ack)
+                cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+                ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+                cand_full = jnp.concatenate(
+                    [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
+                # ptr2 only takes multiples of gcd(P, S): static-roll
+                # switch instead of a full-plane dynamic lane roll.
+                cand_full = ptr_switch(
+                    ptr2, p_cnt, s,
+                    lambda o, c: jnp.roll(c, o, axis=1), cand_full)
+                ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
             recv_fn = (
                 (lambda *a: receive_fused(
                     n, s, cfg.tfail, cfg.tremove, STRIDE,
@@ -734,7 +830,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     fresh_cnt > 1,
                     (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
                     1.0)
-                u = jax.random.uniform(k_entries, (n, s))
+                u = rng.thin_u.reshape(n, s)
                 keep = fresh & ((u < p_keep[:, None]) | is_self_slot)
             keep = keep & act[:, None]
             if cfg.shift_set:
@@ -742,12 +838,10 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # stream, uniform over the K candidates; the delivery
                 # below switches over K static-roll branches.
                 table = shift_table(n, cfg.shift_set)
-                shift_idx = jax.random.randint(
-                    k_shifts, (k_max,), 0, cfg.shift_set)
+                shift_idx = rng.shift_draw
                 shifts = jnp.asarray(table, I32)[shift_idx]
             else:
-                shifts = jax.random.randint(k_shifts, (k_max,), 1,
-                                            max(n, 2))
+                shifts = rng.shift_draw
             cstride = STRIDE % s
             sent_gossip = jnp.zeros((n,), I32)
             recv_add = jnp.zeros((n,), I32)
@@ -783,9 +877,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 payloads = []
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
-                    m = m & ~(jax.random.bernoulli(
-                        jax.random.fold_in(k_drop, j), p_drop, (n, s))
-                        & drop_active)
+                    m = m & ~((rng.gossip_u[j].reshape(n, s) < p_drop)
+                              & drop_active)
                     payloads.append(jnp.where(m, view, U32(0)))
                     cnt = m.sum(1, dtype=I32)
                     sent_gossip = sent_gossip + cnt
@@ -802,9 +895,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
                     if use_drop:
-                        m = m & ~(jax.random.bernoulli(
-                            jax.random.fold_in(k_drop, j), p_drop, (n, s))
-                            & drop_active)
+                        m = m & ~((rng.gossip_u[j].reshape(n, s) < p_drop)
+                                  & drop_active)
                     if track_budget:
                         m, used = _budget_take(m, used)
                     r = shifts[j]
@@ -832,7 +924,6 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     sent_gossip = sent_gossip + cnt
                     recv_add = recv_add + cnt_r
             sent_tick = sent_gossip + sent_req + sent_rep
-            k_drop_s = k_drop
         else:
             eligible = fresh & ~is_self_slot & act[:, None]
             in_seed = seeds[jnp.clip(cur_id, 0)] & present
@@ -878,8 +969,13 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         seed_valid = seeds[seed_idx] & seed_burst_on
         burst_valid = seed_valid[:, None] & fresh[intro][None, :]
         if use_drop:
-            dropped = jax.random.bernoulli(k_drop_s, p_drop,
-                                           (seed_idx.shape[0], s))
+            # Ring: the burst coin comes from the plan's k_drop stream
+            # (the ring mode's k_drop_s == k_drop); scatter keeps its
+            # split-off key.
+            dropped = (rng.burst_u.reshape(seed_idx.shape[0], s) < p_drop
+                       if ring else
+                       jax.random.bernoulli(k_drop_s, p_drop,
+                                            (seed_idx.shape[0], s)))
             burst_valid = burst_valid & ~(dropped & drop_active)
         if track_budget:
             # One wire message per burst entry, after the gossip shifts
@@ -920,8 +1016,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # window state, matching the scatter mode's timing); the
                 # dropped probe is never recorded, so counters and the ack
                 # pipeline both see only surviving probes.
-                p_valid = p_valid & ~(jax.random.bernoulli(
-                    k_ack1, p_drop, p_valid.shape) & drop_active)
+                p_valid = p_valid & ~(
+                    (rng.probe_u.reshape(p_valid.shape) < p_drop)
+                    & drop_active)
             if track_budget:
                 # Probes queue after the gossip shifts; each costs p_red
                 # wire messages.  A budget-dropped probe is never
@@ -940,13 +1037,16 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # p_red wire messages per surviving probe (see closure comment).
             sent_probes = p_valid.sum(1, dtype=I32) * p_red
 
-            ids1 = state.probe_ids1
-            v1 = ids1 > 0
-            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+            # ids1/v1/tgt1 were derived in the ack-candidate block above
+            # (state.probe_ids1 — probes issued at t-1).
             if cfg.count_probe_io:
                 # Exact per-node attribution: probes issued at t-1 arrive
-                # at their targets now; targets that are act send acks.
-                ack_send = v1 & act[tgt1]
+                # at their targets now; targets that are act send acks —
+                # the act-of-target filter rides the packed combined
+                # gather (probe_bits1) on the default arm, its own
+                # [N, P] gather on the split arm.
+                ack_send = v1 & (act[tgt1] if probe_bits1 is None
+                                 else _gathered_act(probe_bits1))
                 recv_probe = jnp.zeros((n + 1,), I32).at[
                     jnp.where(v1, tgt1, n).reshape(-1)].add(
                         p_red, mode="drop")[:n]
@@ -992,14 +1092,19 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # Ack sends take the exact branch's act[tgt] filter (a
                 # dead target sends no ack); recv filtering and the
                 # orphan re-credit live in _will_flush /
-                # _credit_orphan_recvs.
-                will_flush = _will_flush(recv_mask, fail_mask, t,
-                                         fail_time)
-                packed_g = _pack_probe_bits(will_flush, act)[tgt1]
-                per_prober = (v1 & _gathered_flush(packed_g)).sum(
+                # _credit_orphan_recvs.  The filter bits rode the packed
+                # combined gather (probe_bits1) on the default arm; the
+                # split arm gathers its own _pack_probe_bits table.
+                if probe_bits1 is None:
+                    will_flush = _will_flush(recv_mask, fail_mask, t,
+                                             fail_time)
+                    bits1 = _pack_probe_bits(will_flush, act)[tgt1]
+                else:
+                    bits1 = probe_bits1
+                per_prober = (v1 & _gathered_flush(bits1)).sum(
                     1, dtype=I32) * p_red
                 recv_probe = _credit_orphan_recvs(per_prober, will_flush)
-                sent_ack = (v1 & _gathered_act(packed_g)).sum(1, dtype=I32)
+                sent_ack = (v1 & _gathered_act(bits1)).sum(1, dtype=I32)
             sent_tick = sent_tick + sent_probes + sent_ack
             recv_add = recv_add + recv_probe + ack_recv_cnt
             if cfg.probe_io_lag:
@@ -1286,7 +1391,17 @@ def make_config(params: Params, collect_events: bool = True,
         probe_io_none=params.PROBE_IO == "none",
         probe_io_lag=params.PROBE_IO == "approx_lag",
         fused_receive=fused, fused_gossip=fused_g, folded=folded,
-        send_budget=send_budget, shift_set=params.SHIFT_SET)
+        send_budget=send_budget, shift_set=params.SHIFT_SET,
+        # Normalized so configs whose lowering cannot differ share one
+        # compiled runner: non-ring paths keep site-local draws
+        # ('scattered'); probe_gather only exists with ring probes, and
+        # n < 4 lacks the packed table's 30-bit hb headroom
+        # (_pack_probe_table), so those pin 'split'/'packed' defaults.
+        rng_mode=params.RNG_MODE if exchange == "ring" else "scattered",
+        probe_gather=(params.PROBE_GATHER
+                      if exchange == "ring" and params.PROBES > 0
+                      and n >= 4 else
+                      "split" if n < 4 else "packed"))
 
 
 _RUNNER_CACHE: dict = {}
@@ -1295,6 +1410,11 @@ _RUNNER_CACHE: dict = {}
 def _get_runner(cfg: HashConfig, warm: bool):
     cache_key = (cfg, warm)
     if cache_key not in _RUNNER_CACHE:
+        if cfg.rng_mode == "hoisted":
+            raise ValueError(
+                "RNG_MODE hoisted pre-draws per CHECKPOINT_EVERY segment "
+                "— it has no monolithic-scan runner (config.validate "
+                "enforces CHECKPOINT_EVERY > 0)")
         step, init = _get_step_and_init(cfg, warm)
 
         def run(keys, ticks, start_ticks, fail_mask, fail_time,
@@ -1355,24 +1475,35 @@ def _get_step_and_init(cfg: HashConfig, warm: bool):
 def _get_segment_runner(cfg: HashConfig, warm: bool):
     """Chunked-scan twin of :func:`_get_runner`: the carry is an argument,
     so the run can stop at any segment boundary and continue bit-exactly
-    (runtime/checkpoint.py).  probe_io_lag is excluded by config
-    validation (its counter epilogue rides the whole-run scan)."""
+    (runtime/checkpoint.py).  probe_io_lag composes since round 6: its
+    state (probe_ids/act_prev/wf_prev) rides the checkpointed carry, and
+    the run-total counter epilogue is applied by run_scan's finalize
+    hook after the last segment.
+
+    With ``RNG_MODE: hoisted`` the whole segment's random material is
+    pre-drawn OUTSIDE the scan as ``[K, ...]`` tensors
+    (vmap of the same per-tick builder the inline step uses —
+    _ring_rng_builder, so the streams are bit-identical) and the scan
+    consumes slices: RNG leaves the per-tick critical path entirely."""
     cache_key = (cfg, warm, "segment")
     if cache_key not in _RUNNER_CACHE:
-        if cfg.probe_io_lag:
-            raise ValueError(
-                "CHECKPOINT_EVERY is incompatible with PROBE_IO "
-                "approx_lag")
         step, _ = _get_step_and_init(cfg, warm)
+        hoist = cfg.rng_mode == "hoisted"
+        if hoist and cfg.exchange != "ring":
+            raise ValueError("RNG_MODE hoisted requires the ring exchange")
+        build = (_ring_rng_builder(cfg, cfg.drop_prob > 0.0) if hoist
+                 else None)
 
         def run_seg(state, ticks, keys, start_ticks, fail_mask, fail_time,
                     drop_lo, drop_hi):
+            xs = (ticks, jax.vmap(build)(keys)) if hoist else (ticks, keys)
+
             def body(state, inp):
                 t, k = inp
                 return step(state, (t, k, start_ticks, fail_mask,
                                     fail_time, drop_lo, drop_hi))
 
-            return jax.lax.scan(body, state, (ticks, keys))
+            return jax.lax.scan(body, state, xs)
 
         _RUNNER_CACHE[cache_key] = jax.jit(run_seg)
     return _RUNNER_CACHE[cache_key]
@@ -1401,13 +1532,40 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
             chunked_run, compact_sparse)
         _, init = _get_step_and_init(cfg, warm)
         warm_key = make_run_key(params, seed ^ 0x5EED)
+        finalize = None
+        if cfg.probe_io_lag and cfg.probes > 0:
+            def finalize(carry, acc):
+                """Host-side twin of _get_runner's on-device lag tail:
+                the final tick's ack sends (probes issued T-2 arriving
+                T-1, still in the final probe_ids2/act_prev snapshots)
+                are added so run totals equal exact mode's.  Applied by
+                the chunked driver after the LAST segment — the carry
+                snapshots on disk stay pre-epilogue, so a resumed run
+                applies it exactly once (tests/test_checkpoint.py)."""
+                ids2f = np.asarray(carry.probe_ids2).astype(np.int64)
+                act_prev = np.asarray(carry.act_prev)
+                corr = ((ids2f > 0) & act_prev[
+                    np.clip(ids2f - 1, 0, None)]).sum(1).astype(np.int32)
+                if collect_events:
+                    sent = acc.sent.copy()
+                    sent[-1] = sent[-1] + corr
+                    acc = acc._replace(sent=sent)
+                else:
+                    carry = carry._replace(agg=carry.agg._replace(
+                        sent_total=np.asarray(carry.agg.sent_total)
+                        + corr))
+                    sent = acc[2].copy()         # SparseTickEvents.sent
+                    sent[-1] += int(corr.sum())
+                    acc = (acc[0], acc[1], sent, acc[3])
+                return carry, acc
         return chunked_run(
             params, plan, seed, total,
             init_carry=lambda: init(warm_key),
             segment_fn=_get_segment_runner(cfg, warm),
             collect_events=collect_events,
             compact_fn=compact_sparse if collect_events else None,
-            event_type=None if collect_events else SparseTickEvents)
+            event_type=None if collect_events else SparseTickEvents,
+            finalize=finalize)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)

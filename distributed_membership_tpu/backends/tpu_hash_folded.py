@@ -145,21 +145,35 @@ def _sumP(x, rows, fp, p_cnt):
 
 
 def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
-                         recv_mask, k_ack, p_drop, use_drop,
-                         drop_lo, drop_hi):
+                         recv_mask, ack_u, p_drop, use_drop,
+                         drop_lo, drop_hi, tbl=None, ids1=None):
     """Ack candidates for probes issued at t-2 (the gather pipeline of
     tpu_hash.make_step ring), on P-folded probe state.  ``vec`` is the
     lagged heartbeat vector ([N]; the sharded caller passes its
-    all_gather).  Returns (cand_sf [rows/F, 128], ack_recv_cnt [rows])."""
-    from distributed_membership_tpu.backends.tpu_hash import ptr_switch
+    all_gather).  ``ack_u`` is the planned ack-leg drop uniform (flat,
+    ops/rng_plan — None when drops are off).  When ``tbl`` (the packed
+    probe table, tpu_hash._pack_probe_table — the sharded caller passes
+    its single all_gather) and ``ids1`` are given, the ack heartbeat AND
+    the t-1 counter-filter bits ride ONE concatenated gather; returns
+    (cand_sf [rows/F, 128], ack_recv_cnt [rows], bits1) with ``bits1``
+    the packed filter bits gathered at the t-1 targets (None on the
+    split arm)."""
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _gathered_hb, ptr_switch)
 
     id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-    hb_ack = vec[id2]
+    bits1 = None
+    if tbl is not None and ids1 is not None:
+        tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+        gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
+        hb_ack = _gathered_hb(gcat[:, :id2.shape[1]])
+        bits1 = gcat[:, id2.shape[1]:]
+    else:
+        hb_ack = vec[id2]
     valid2 = (ids2 > 0) & (hb_ack > 0)
     if use_drop:
         da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-        valid2 &= ~(jax.random.bernoulli(k_ack, p_drop, ids2.shape)
-                    & da_ack)
+        valid2 &= ~((ack_u.reshape(ids2.shape) < p_drop) & da_ack)
     cand = jnp.where(
         valid2, hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
     ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
@@ -171,11 +185,13 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
                          cand_ext[cand_idx])
     ack_recv_cnt = _sumP(valid2 & _repP(recv_mask, rows, fp, p_cnt),
                          rows, fp, p_cnt).astype(I32)
-    return cand_sf, ack_recv_cnt
+    return cand_sf, ack_recv_cnt, bits1
 
 
-def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, k_entries):
-    """Gossip entry thinning to ~G per row (self always kept), folded."""
+def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, thin_u):
+    """Gossip entry thinning to ~G per row (self always kept), folded.
+    ``thin_u`` is the planned thinning uniform (flat, ops/rng_plan —
+    same flat bits as the natural layout's (N, S) draw)."""
     if g >= s:
         keep = fresh
     else:
@@ -184,15 +200,16 @@ def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, k_entries):
             fresh_cnt > 1,
             (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
             1.0)
-        u = jax.random.uniform(k_entries, fresh.shape)
+        u = thin_u.reshape(fresh.shape)
         keep = fresh & ((u < rep(p_keep)) | is_self_slot)
     return keep & rep(act)
 
 
 def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
-                       node_p, k_drop, p_drop, use_drop, drop_active):
+                       node_p, probe_u, p_drop, use_drop, drop_active):
     """Issue this tick's probes from the cyclic window (P-folded).
-    Returns (ids_new [rows/FP, 128] u32, p_valid bool)."""
+    ``probe_u`` is the planned issue-time drop uniform (flat; None when
+    drops are off).  Returns (ids_new [rows/FP, 128] u32, p_valid bool)."""
     from distributed_membership_tpu.backends.tpu_hash import ptr_switch
 
     ptr = jax.lax.rem(t * p_cnt, s)
@@ -203,8 +220,8 @@ def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
     w_id = ((window - U32(1)) % U32(n)).astype(I32)
     p_valid = w_pres & (w_id != node_p) & _repP(act, rows, fp, p_cnt)
     if use_drop:
-        p_valid = p_valid & ~(jax.random.bernoulli(
-            k_drop, p_drop, p_valid.shape) & drop_active)
+        p_valid = p_valid & ~(
+            (probe_u.reshape(p_valid.shape) < p_drop) & drop_active)
     ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
     return ids_new, p_valid
 
@@ -264,24 +281,20 @@ def make_folded_step(cfg):
     def rowany(x):
         return x.reshape(nf, f, s).any(-1).reshape(n)
 
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _ring_rng_builder)
+    rng_build = _ring_rng_builder(cfg, use_drop)
+    packed = cfg.probe_gather == "packed" and n >= 4
+
     def step(state, inputs):
         t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
-        (k_targets, k_entries, k_drop, k_ctrl, k_drop_p, k_shifts,
-         k_ack1, k_ack2) = jax.random.split(key, 8)
+        from distributed_membership_tpu.ops.rng_plan import RingRng
+        rng = key if isinstance(key, RingRng) else rng_build(key)
         p_drop = cfg.drop_prob
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = rep(recv_mask)
-
-        # ---- ack candidates (gather pipeline, P-folded, shared) ----
-        ack_recv_cnt = jnp.zeros((n,), I32)
-        cand_sf = jnp.zeros((nf, LANES), U32)
-        if p_cnt > 0:
-            vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            cand_sf, ack_recv_cnt = _fold_ack_candidates(
-                n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2, vec,
-                recv_mask, k_ack2, p_drop, use_drop, drop_lo, drop_hi)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -292,6 +305,27 @@ def make_folded_step(cfg):
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
             + idx.astype(U32) + U32(1)
+
+        # ---- ack candidates (gather pipeline, P-folded, shared) ----
+        # Sits after act so the packed probe table can ride the counter
+        # bits on the SAME gather (tpu_hash._pack_probe_table).
+        ack_recv_cnt = jnp.zeros((n,), I32)
+        cand_sf = jnp.zeros((nf, LANES), U32)
+        will_flush = bits1 = None
+        if p_cnt > 0:
+            from distributed_membership_tpu.backends.tpu_hash import (
+                _pack_probe_table, _will_flush)
+            vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            tbl = ids1_for_tbl = None
+            if packed and not cfg.probe_io_none:
+                will_flush = _will_flush(recv_mask, fail_mask, t,
+                                         fail_time)
+                tbl = _pack_probe_table(vec, will_flush, act)
+                ids1_for_tbl = state.probe_ids1
+            cand_sf, ack_recv_cnt, bits1 = _fold_ack_candidates(
+                n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2, vec,
+                recv_mask, rng.ack_u if use_drop else None, p_drop,
+                use_drop, drop_lo, drop_hi, tbl=tbl, ids1=ids1_for_tbl)
 
         # ---- receive: admit + ack + self + sweep (shared folded core) --
         (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
@@ -308,7 +342,7 @@ def make_folded_step(cfg):
         k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
 
         keep = _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum,
-                          k_entries)
+                          rng.thin_u if g < s else None)
         if cfg.shift_set:
             # Static-table shifts (SHIFT_SET, same key stream and draw
             # as tpu_hash.make_step so folded stays bit-exact with the
@@ -318,11 +352,10 @@ def make_folded_step(cfg):
             from distributed_membership_tpu.backends.tpu_hash import (
                 shift_table)
             table = shift_table(n, cfg.shift_set)
-            shift_idx = jax.random.randint(
-                k_shifts, (k_max,), 0, cfg.shift_set)
+            shift_idx = rng.shift_draw
             shifts = jnp.asarray(table, I32)[shift_idx]
         else:
-            shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+            shifts = rng.shift_draw
         sent_gossip = jnp.zeros((n,), I32)
         recv_add = jnp.zeros((n,), I32)
 
@@ -350,9 +383,8 @@ def make_folded_step(cfg):
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
-                m = m & ~(jax.random.bernoulli(
-                    jax.random.fold_in(k_drop, jshift), p_drop,
-                    (nf, LANES)) & drop_active)
+                m = m & ~((rng.gossip_u[jshift].reshape(nf, LANES)
+                           < p_drop) & drop_active)
             r = shifts[jshift]
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
@@ -397,7 +429,8 @@ def make_folded_step(cfg):
         if p_cnt > 0:
             ids_new, p_valid = _fold_probe_window(
                 n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
-                k_ack1, p_drop, use_drop, drop_active)
+                rng.probe_u if use_drop else None, p_drop, use_drop,
+                drop_active)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             psum_row = lambda x: _sumP(x, n, fp, p_cnt)  # noqa: E731
@@ -407,7 +440,13 @@ def make_folded_step(cfg):
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
             if cfg.count_probe_io:
-                ack_send = v1 & act[tgt1]
+                from distributed_membership_tpu.backends.tpu_hash import (
+                    _gathered_act)
+                # act-of-target filter rides the packed combined gather
+                # (bits1 — _fold_ack_candidates) on the default arm, its
+                # own gather on the split arm.
+                ack_send = v1 & (act[tgt1] if bits1 is None
+                                 else _gathered_act(bits1))
                 recv_probe = jnp.zeros((n + 1,), I32).at[
                     jnp.where(v1, tgt1, n).reshape(-1)].add(
                         p_red, mode="drop")[:n]
@@ -423,16 +462,18 @@ def make_folded_step(cfg):
             else:
                 # Approximate per-node split, exact totals — the filters
                 # of tpu_hash.make_step's scale branch on folded planes
-                # (see _will_flush / _credit_orphan_recvs there).
+                # (see _will_flush / _credit_orphan_recvs there).  On the
+                # default arm the bits rode the combined ack gather
+                # (bits1); the split arm gathers its own bit table.
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs, _gathered_act, _gathered_flush,
                     _pack_probe_bits, _will_flush)
-                will_flush = _will_flush(recv_mask, fail_mask, t,
-                                         fail_time)
-                # One packed random gather for both per-target bits
-                # (tpu_hash.make_step's scale-branch packing, on the
-                # folded planes).
-                packed_g = _pack_probe_bits(will_flush, act)[tgt1]
+                if bits1 is None:
+                    will_flush = _will_flush(recv_mask, fail_mask, t,
+                                             fail_time)
+                    packed_g = _pack_probe_bits(will_flush, act)[tgt1]
+                else:
+                    packed_g = bits1
                 per_prober = psum_row(
                     (v1 & _gathered_flush(packed_g)).astype(I32)) * p_red
                 recv_probe = _credit_orphan_recvs(per_prober, will_flush)
@@ -528,6 +569,11 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
     AX = axes if len(axes) > 1 else axes[0]
     block_send = make_block_send(n_shards, axes, axis_sizes or (n_shards,))
 
+    from distributed_membership_tpu.ops.rng_plan import (
+        RingRng, sharded_ring_rng)
+    packed = cfg.probe_gather == "packed" and n >= 4
+    seed_rows = min(cfg.seed_cap, n)
+
     def step(state, inputs):
         t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = \
             inputs
@@ -541,25 +587,14 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
         start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,),
                                           (n_local,))
-        key_l = jax.random.fold_in(key, me)
-        k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(
-            key_l, 4)
-        k_shifts = jax.random.fold_in(key, 0x517F)
+        rng = key if isinstance(key, RingRng) else sharded_ring_rng(
+            key, me, n=n, n_local=n_local, s=s, g=g, k_max=k_max,
+            p_cnt=max(p_cnt, 0), seed_rows=seed_rows, use_drop=use_drop,
+            cold_join=False, batched=cfg.rng_mode != "scattered")
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = rep(recv_mask)
-
-        # ---- ack candidates (gather pipeline, P-folded, shared) ----
-        ack_recv_cnt = jnp.zeros((n_local,), I32)
-        cand_sf = jnp.zeros((lf, LANES), U32)
-        if p_cnt > 0:
-            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
-            cand_sf, ack_recv_cnt = _fold_ack_candidates(
-                n, s, p_cnt, fp, cand_idx, n_local, t, state.probe_ids2,
-                vec_g, recv_mask, k_ack2, cfg.drop_prob, use_drop,
-                drop_lo, drop_hi)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -570,6 +605,37 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
             + lrows.astype(U32) + U32(1)
+
+        # ---- ack candidates (gather pipeline, P-folded, shared) ----
+        # After act: on the packed arm the per-node probe table
+        # (heartbeat + will-flush + act bits, tpu_hash._pack_probe_table)
+        # travels as ONE [N] u32 all_gather — replacing the separate
+        # vec/act/will_flush gathers — and the counter bits ride the
+        # same concatenated per-target gather.
+        ack_recv_cnt = jnp.zeros((n_local,), I32)
+        cand_sf = jnp.zeros((lf, LANES), U32)
+        will_flush_l = will_flush_g = bits1 = None
+        if p_cnt > 0:
+            from distributed_membership_tpu.backends.tpu_hash import (
+                _gathered_flush, _pack_probe_table, _will_flush)
+            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            tbl = ids1_for_tbl = None
+            if packed and not cfg.probe_io_none:
+                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                           fail_time)
+                tbl = lax.all_gather(
+                    _pack_probe_table(vec_l, will_flush_l, act), AX,
+                    tiled=True)                             # ONE [N] wire
+                will_flush_g = _gathered_flush(tbl)
+                vec_g = None
+                ids1_for_tbl = state.probe_ids1
+            else:
+                vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
+            cand_sf, ack_recv_cnt, bits1 = _fold_ack_candidates(
+                n, s, p_cnt, fp, cand_idx, n_local, t, state.probe_ids2,
+                vec_g, recv_mask, rng.ack_u if use_drop else None,
+                cfg.drop_prob, use_drop, drop_lo, drop_hi, tbl=tbl,
+                ids1=ids1_for_tbl)
 
         # ---- receive: admit + ack + self + sweep (shared folded core) --
         (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
@@ -585,18 +651,17 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         is_self_slot = cur_id == node
         k_eff = jnp.clip(jnp.minimum(cfg.fanout, numpotential), 0)
         keep = _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum,
-                          k_entries)
+                          rng.thin_u if g < s else None)
 
-        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        shifts = rng.shift_draw
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
-                m = m & ~(jax.random.bernoulli(
-                    jax.random.fold_in(k_dropg, jshift), cfg.drop_prob,
-                    (lf, LANES)) & drop_active)
+                m = m & ~((rng.gossip_u[jshift].reshape(lf, LANES)
+                           < cfg.drop_prob) & drop_active)
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
             sent_gossip = sent_gossip + cnt
@@ -645,8 +710,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         if p_cnt > 0:
             ids_new, p_valid = _fold_probe_window(
                 n, s, p_cnt, fp, window_idx, n_local, t, view, act,
-                local_node_p + row0, k_probe_drop, cfg.drop_prob,
-                use_drop, drop_active)
+                local_node_p + row0, rng.probe_u if use_drop else None,
+                cfg.drop_prob, use_drop, drop_active)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             psum_row = lambda x: _sumP(x, n_local, fp, p_cnt)  # noqa: E731
@@ -658,12 +723,19 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             ids1 = state.probe_ids1
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)    # global target ids
-            # act_g gathered per-branch: the profiling-only 'none' branch
-            # must structurally pay no [N] all_gather (its whole point is
+            # act_g gathered per-branch on the split arm only: the packed
+            # arm's act bit already rode the single all_gather + combined
+            # gather (bits1), and the profiling-only 'none' branch must
+            # structurally pay no [N] all_gather (its whole point is
             # removing the counter-side ops from the measured tick).
             if cfg.count_probe_io:
-                act_g = lax.all_gather(act, AX, tiled=True)      # [N]
-                ack_send = v1 & act_g[tgt1]
+                from distributed_membership_tpu.backends.tpu_hash import (
+                    _gathered_act as _g_act)
+                if bits1 is None:
+                    act_g = lax.all_gather(act, AX, tiled=True)  # [N]
+                    ack_send = v1 & act_g[tgt1]
+                else:
+                    ack_send = v1 & _g_act(bits1)
                 recv_hist = jnp.zeros((n + 1,), I32).at[
                     jnp.where(v1, tgt1, n).reshape(-1)].add(
                         p_red, mode="drop")[:n]
@@ -684,14 +756,20 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs_sharded, _gathered_act,
                     _gathered_flush, _pack_probe_bits, _will_flush)
-                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                           fail_time)
-                will_flush_g = lax.all_gather(
-                    will_flush_l, AX, tiled=True)            # [N]
-                act_g = lax.all_gather(act, AX, tiled=True)      # [N]
-                # One packed random gather for both per-target bits
-                # (act + will_flush share tgt1).
-                packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]
+                if bits1 is None:
+                    # split arm: three separate all_gathers + a bit-table
+                    # gather (the pre-round-6 lowering).
+                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                               fail_time)
+                    will_flush_g = lax.all_gather(
+                        will_flush_l, AX, tiled=True)        # [N]
+                    act_g = lax.all_gather(act, AX, tiled=True)  # [N]
+                    packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]
+                else:
+                    # packed arm: the bits rode the combined gather, and
+                    # will_flush_g is the single all_gathered table's
+                    # low bit (ack-candidate block above).
+                    packed_g = bits1
                 per_prober = psum_row(
                     (v1 & _gathered_flush(packed_g)).astype(I32)) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(

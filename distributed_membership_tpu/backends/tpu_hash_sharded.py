@@ -67,8 +67,9 @@ from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.backends.tpu_hash import (
     STRIDE, HashConfig, I32, U32, _credit_orphan_recvs_sharded,
-    _gathered_act, _gathered_flush, _pack_probe_bits, ptr_switch,
-    _will_flush, make_admit, make_config, pack, slot_of, unpack)
+    _gathered_act, _gathered_flush, _gathered_hb, _pack_probe_bits,
+    _pack_probe_table, ptr_switch, _will_flush, make_admit, make_config,
+    pack, slot_of, unpack)
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
 from distributed_membership_tpu.config import Params
@@ -347,6 +348,10 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
     block_send = make_block_send(n_shards, axes,
                                  axis_sizes or (n_shards,))
 
+    from distributed_membership_tpu.ops.rng_plan import sharded_ring_rng
+    packed_gather = cfg.probe_gather == "packed" and n >= 4
+    seed_rows = min(cfg.seed_cap, n)
+
     def step(state: ShardedHashState, inputs):
         t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = inputs
         me = lax.axis_index(AX)
@@ -354,9 +359,14 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         lrows = row0 + l_idx
         fail_mask_l = lax.dynamic_slice(fail_mask_g, (row0,), (n_local,))
         start_ticks_l = lax.dynamic_slice(start_ticks_g, (row0,), (n_local,))
-        key_l = jax.random.fold_in(key, me)
-        k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(key_l, 4)
-        k_shifts = jax.random.fold_in(key, 0x517F)     # replicated stream
+        # Per-tick RNG plan (ops/rng_plan.py): same key derivations and
+        # bits as the scattered per-site draws; RNG_MODE batched groups
+        # the same-size streams into one vmapped threefry.
+        rng = sharded_ring_rng(
+            key, me, n=n, n_local=n_local, s=s, g=g, k_max=k_max,
+            p_cnt=max(cfg.probes, 0), seed_rows=seed_rows,
+            use_drop=use_drop, cold_join=cold_join,
+            batched=cfg.rng_mode != "scattered")
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
         # ---- receive: admit + ack + self + sweep as one fused pass ----
@@ -375,9 +385,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             intro_failed = fail_mask_g[INTRO] & (t > fail_time)
             intro_recv = ((t > start_ticks_g[INTRO]) & ~intro_failed)
             if use_drop:
-                k_ctrl = jax.random.fold_in(key, 0xC281)
-                ctrl_kept_g = ~(jax.random.bernoulli(
-                    k_ctrl, cfg.drop_prob, (2, n)) & drop_active)
+                ctrl_kept_g = ~((rng.ctrl_u.reshape(2, n) < cfg.drop_prob)
+                                & drop_active)
             else:
                 ctrl_kept_g = jnp.ones((2, n), bool)
 
@@ -415,33 +424,6 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             sent_req = sent_rep = jnp.zeros((n_local,), I32)
             pending_joins = jnp.zeros((n_local,), I32)
 
-        ack_recv_cnt = jnp.zeros((n_local,), I32)
-        cand_full = jnp.zeros((n_local, s), U32)
-        if cfg.probes > 0:
-            # Ack candidates for probes issued at t-2 (gather pipeline):
-            # one [N] all_gather of the lagged heartbeat vector is the
-            # whole cross-shard probe subsystem.
-            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
-            vec_g = lax.all_gather(vec_l, AX, tiled=True)     # [N]
-            ids2 = state.probe_ids2
-            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-            hb_ack = vec_g[id2]
-            valid2 = (ids2 > 0) & (hb_ack > 0)
-            if use_drop:
-                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                valid2 &= ~(jax.random.bernoulli(
-                    k_ack2, cfg.drop_prob, ids2.shape) & da_ack)
-            cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
-            ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
-            cand_full = jnp.concatenate(
-                [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
-            # Static-roll switch over the pointer's multiples-of-gcd set
-            # (see tpu_hash.ptr_switch).
-            cand_full = ptr_switch(
-                ptr2, cfg.probes, s,
-                lambda o, c: jnp.roll(c, o, axis=1), cand_full)
-            ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
-
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = (jnp.where(recv_mask, 0, state.pending_recv)
                         + pending_joins)
@@ -452,6 +434,53 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_on = (act | (is_intro_row & boot)) if cold_join else act
         self_val = pack(cfg, jnp.where(act, own_hb, 0), lrows)
+
+        ack_recv_cnt = jnp.zeros((n_local,), I32)
+        cand_full = jnp.zeros((n_local, s), U32)
+        will_flush_l = will_flush_g = probe_bits1 = None
+        if cfg.probes > 0:
+            # Ack candidates for probes issued at t-2 (gather pipeline):
+            # one [N] all_gather is the whole cross-shard probe
+            # subsystem.  On the default packed arm that gather carries
+            # the whole per-node probe table — lagged heartbeat +
+            # will-flush + act bits (tpu_hash._pack_probe_table), so the
+            # separate act/will_flush all_gathers of the counting
+            # branches disappear — and the t-1 counter bits ride the
+            # SAME per-target gather as the ack value ([N, 2P] indices).
+            vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
+            ids2 = state.probe_ids2
+            id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+            ids1 = state.probe_ids1
+            v1 = ids1 > 0
+            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
+            if packed_gather and not cfg.probe_io_none:
+                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                           fail_time)
+                tbl_g = lax.all_gather(
+                    _pack_probe_table(vec_l, will_flush_l, act), AX,
+                    tiled=True)                          # ONE [N] wire
+                will_flush_g = _gathered_flush(tbl_g)
+                gcat = tbl_g[jnp.concatenate([id2, tgt1], axis=1)]
+                hb_ack = _gathered_hb(gcat[:, :cfg.probes])
+                probe_bits1 = gcat[:, cfg.probes:]
+            else:
+                vec_g = lax.all_gather(vec_l, AX, tiled=True)     # [N]
+                hb_ack = vec_g[id2]
+            valid2 = (ids2 > 0) & (hb_ack > 0)
+            if use_drop:
+                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                valid2 &= ~((rng.ack_u.reshape(ids2.shape)
+                             < cfg.drop_prob) & da_ack)
+            cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+            ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
+            cand_full = jnp.concatenate(
+                [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
+            # Static-roll switch over the pointer's multiples-of-gcd set
+            # (see tpu_hash.ptr_switch).
+            cand_full = ptr_switch(
+                ptr2, cfg.probes, s,
+                lambda o, c: jnp.roll(c, o, axis=1), cand_full)
+            ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_fn = (
             (lambda *a: receive_fused(
@@ -502,20 +531,19 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 fresh_cnt > 1,
                 (g - 1) / jnp.maximum(fresh_cnt - 1, 1).astype(jnp.float32),
                 1.0)
-            u_keep = jax.random.uniform(k_entries, (n_local, s))
+            u_keep = rng.thin_u.reshape(n_local, s)
             keep = fresh & ((u_keep < p_keep[:, None]) | is_self_slot)
         keep = keep & act[:, None]
 
-        shifts = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+        shifts = rng.shift_draw
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for j in range(k_max):
             m = keep & (j < k_eff)[:, None]
             if use_drop:
-                m = m & ~(jax.random.bernoulli(
-                    jax.random.fold_in(k_dropg, j), cfg.drop_prob,
-                    (n_local, s)) & drop_active)
+                m = m & ~((rng.gossip_u[j].reshape(n_local, s)
+                           < cfg.drop_prob) & drop_active)
             payload = jnp.where(m, view, U32(0))
             cnt = m.sum(1, dtype=I32)
             sent_gossip = sent_gossip + cnt
@@ -582,9 +610,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             seed_valid = seeds_g[seed_idx] & seed_burst_on
             burst_valid = seed_valid[:, None] & b_fresh[None, :]
             if use_drop:
-                k_burst = jax.random.fold_in(key, 0xB125)
                 burst_valid = burst_valid & ~(
-                    jax.random.bernoulli(k_burst, cfg.drop_prob, (cap, s))
+                    (rng.burst_u.reshape(cap, s) < cfg.drop_prob)
                     & drop_active)
             owned = (seed_idx >= row0) & (seed_idx < row0 + n_local)
             lrow = jnp.clip(seed_idx - row0, 0, n_local - 1)
@@ -619,22 +646,26 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             w_id = ((window - U32(1)) % U32(n)).astype(I32)
             p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
             if use_drop:
-                p_valid = p_valid & ~(jax.random.bernoulli(
-                    k_probe_drop, cfg.drop_prob, p_valid.shape) & drop_active)
+                p_valid = p_valid & ~(
+                    (rng.probe_u.reshape(p_valid.shape) < cfg.drop_prob)
+                    & drop_active)
             ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             sent_probes = p_valid.sum(1, dtype=I32) * p_red
-            ids1 = state.probe_ids1
-            v1 = ids1 > 0
-            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
-            # act of every node this tick — the counting branches need
-            # the act-of-target filter for exact totals (dead targets
-            # send no ack); gathered per-branch so the profiling-only
-            # 'none' branch structurally pays no [N] all_gather.
+            # ids1/v1/tgt1 were derived in the ack-candidate block above
+            # (state.probe_ids1 — probes issued at t-1).  The
+            # act-of-target filter rode the packed table's single
+            # all_gather + combined gather on the default arm
+            # (probe_bits1); the split arm gathers per-branch so the
+            # profiling-only 'none' branch structurally pays no [N]
+            # all_gather.
             if cfg.count_probe_io:
-                act_g = lax.all_gather(act, AX, tiled=True)     # [N]
-                ack_send = v1 & act_g[tgt1]
+                if probe_bits1 is None:
+                    act_g = lax.all_gather(act, AX, tiled=True)     # [N]
+                    ack_send = v1 & act_g[tgt1]
+                else:
+                    ack_send = v1 & _gathered_act(probe_bits1)
                 # Exact per-target attribution (tpu_hash.make_step's
                 # exact branch, distributed): local histograms over the
                 # GLOBAL index space, summed-and-sliced back to the
@@ -659,15 +690,20 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 # Approximate per-node split, exact totals — the filters
                 # of tpu_hash.make_step's scale branch, distributed
                 # (_will_flush / _credit_orphan_recvs_sharded there).
-                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                           fail_time)
-                will_flush_g = lax.all_gather(
-                    will_flush_l, AX, tiled=True)        # [N]
-                act_g = lax.all_gather(act, AX, tiled=True)     # [N]
-                # One packed random gather for both per-target bits
-                # (act + will_flush share tgt1) — the single-chip scale
-                # branch's packing, distributed.
-                packed_g = _pack_probe_bits(will_flush_g, act_g)[tgt1]
+                if probe_bits1 is None:
+                    # split arm: three separate all_gathers + its own
+                    # per-target bit gather (pre-round-6 lowering).
+                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                               fail_time)
+                    will_flush_g = lax.all_gather(
+                        will_flush_l, AX, tiled=True)        # [N]
+                    act_g = lax.all_gather(act, AX, tiled=True)     # [N]
+                    packed_g = _pack_probe_bits(will_flush_g,
+                                                act_g)[tgt1]
+                else:
+                    # packed arm: bits1 rode the combined gather;
+                    # will_flush_l/_g came from the packed table.
+                    packed_g = probe_bits1
                 per_prober = (v1 & _gathered_flush(packed_g)).sum(
                     1, dtype=I32) * p_red
                 recv_probe = _credit_orphan_recvs_sharded(

@@ -170,6 +170,36 @@ class Params:
     # cross-run bit-stability for throughput).  The host/emul backends
     # use Python RNG and ignore this key.
     PRNG_IMPL: str = "threefry2x32"
+    # How the ring-exchange steps draw their per-tick random streams
+    # (ops/rng_plan.py; bit-for-bit identical streams in every mode —
+    # the keys and bits are the same, only the threefry invocation
+    # structure changes):
+    #   'scattered' — one threefry expansion per draw site (the
+    #     pre-round-6 lowering; kept as the ladder's A/B arm),
+    #   'batched' (default) — same-size draws stacked into ONE vmapped
+    #     threefry over the stacked keys (~(1+fanout) [N, S] coins per
+    #     tick collapse into one invocation — the round-4 census's ~9G
+    #     threefry element-ops/tick suspect, engineered down),
+    #   'hoisted' — batched AND pre-drawn per CHECKPOINT_EVERY segment
+    #     as [K, ...] tensors, so RNG leaves the per-tick critical path
+    #     entirely.  Opt-in: requires CHECKPOINT_EVERY > 0 and the
+    #     single-chip tpu_hash backend, and costs
+    #     O(CHECKPOINT_EVERY * fanout * N * S) floats of device memory.
+    # Non-ring paths (scatter exchange, emul/dense/sparse backends)
+    # keep their site-local draws regardless.
+    RNG_MODE: str = "batched"
+    # Probe/ack pipeline gather lowering on the ring paths:
+    #   'packed' (default) — ack heartbeat + will-flush + act + counter
+    #     bits ride ONE packed-u32 per-target gather per tick (indices
+    #     for the t-2 ack application and the t-1 counter attribution
+    #     concatenated into a single [N, 2P] gather; on the sharded
+    #     ring the three [N] all_gathers collapse into one) — the
+    #     mitigation for the census's four-[N, P]-random-gathers
+    #     suspect, bit-exact with 'split' in every PROBE_IO mode,
+    #   'split' — the pre-round-6 two-gather lowering, kept for the
+    #     ladder's A/B arm (1M_s16_onegather) and the bit-exactness
+    #     pins (tests/test_rng_plan.py, tests/test_probe_io.py).
+    PROBE_GATHER: str = "packed"
     # Natural-layout roll mitigation (round-5 experiment): draw each
     # tick's gossip shifts from a STATIC K-entry table instead of
     # uniform [1, N), and deliver via lax.switch over K static-roll
@@ -200,6 +230,12 @@ class Params:
     # Directory for checkpoint snapshots + MANIFEST.json ('' = chunk the
     # scan but persist nothing — the memory win without the disk I/O).
     CHECKPOINT_DIR: str = ""
+    # 1 = write snapshots with np.savez_compressed (zlib): smaller files
+    # and less disk bandwidth for more CPU per boundary — the snapshot
+    # write runs on the background writer thread (runtime/checkpoint.py
+    # double-buffers it against the next segment's device work), so the
+    # CPU usually hides.  Resume reads either format transparently.
+    CHECKPOINT_COMPRESS: int = 0
     # 1 = resume from CHECKPOINT_DIR's latest valid checkpoint when one
     # exists (manifest validated against this config/seed — a mismatch
     # raises instead of silently computing a different run); when none
@@ -212,7 +248,8 @@ class Params:
         return self.globaltime
 
     # ------------------------------------------------------------------
-    def setparams(self, config_file: str) -> "Params":
+    def setparams(self, config_file: str,
+                  validate: bool = True) -> "Params":
         """Parse a .conf file (legacy 4-key format + extensions).
 
         Mirrors Params::setparams (Params.cpp:19-40): reads the four legacy
@@ -221,10 +258,10 @@ class Params:
         """
         with open(config_file, "r") as fh:
             text = fh.read()
-        self.parse(text)
+        self.parse(text, validate=validate)
         return self
 
-    def parse(self, text: str) -> "Params":
+    def parse(self, text: str, validate: bool = True) -> "Params":
         for line in text.splitlines():
             line = line.strip()
             if not line or line.startswith("#"):
@@ -239,7 +276,8 @@ class Params:
         self.EN_GPSZ = self.MAX_NNB
         self.globaltime = 0
         self.dropmsg = 0
-        self.validate()
+        if validate:
+            self.validate()
         return self
 
     def _set(self, key: str, raw: str) -> None:
@@ -300,11 +338,42 @@ class Params:
                 f"CHECKPOINT_EVERY is not supported by BACKEND "
                 f"{self.BACKEND!r} (chunked drivers: tpu, tpu_sparse, "
                 "tpu_hash, tpu_hash_sharded)")
-        if self.CHECKPOINT_EVERY and self.PROBE_IO == "approx_lag":
+        # (CHECKPOINT_EVERY x PROBE_IO approx_lag composes since round 6:
+        # the lag state rides the checkpointed carry and the counter
+        # epilogue is applied by the chunked driver's finalize hook —
+        # kill/resume bit-exactness pinned in tests/test_checkpoint.py.)
+        if self.RNG_MODE not in ("scattered", "batched", "hoisted"):
             raise ValueError(
-                "CHECKPOINT_EVERY is incompatible with PROBE_IO "
-                "approx_lag (its counter epilogue rides the whole-run "
-                "scan)")
+                f"RNG_MODE must be scattered|batched|hoisted, got "
+                f"{self.RNG_MODE!r}")
+        if self.RNG_MODE == "hoisted":
+            # Loud-rejection policy: hoisting pre-draws one SEGMENT of
+            # RNG, so it only exists inside the chunked driver, and only
+            # the single-chip tpu_hash runners implement the plan-fed
+            # scan (the sharded step derives per-shard streams inside
+            # shard_map — hoist there would silently change nothing).
+            if self.CHECKPOINT_EVERY <= 0:
+                raise ValueError(
+                    "RNG_MODE hoisted requires CHECKPOINT_EVERY > 0 "
+                    "(the pre-drawn [K, ...] RNG tensors are segment-"
+                    "scoped; a whole-run hoist would be O(T*fanout*N*S) "
+                    "memory)")
+            if self.BACKEND != "tpu_hash":
+                raise ValueError(
+                    "RNG_MODE hoisted is single-chip tpu_hash only "
+                    f"(got BACKEND {self.BACKEND!r})")
+            if self.resolved_exchange() != "ring":
+                raise ValueError(
+                    "RNG_MODE hoisted requires the ring exchange (the "
+                    "scatter lowering keeps its site-local draws)")
+        if self.PROBE_GATHER not in ("packed", "split"):
+            raise ValueError(
+                f"PROBE_GATHER must be packed|split, got "
+                f"{self.PROBE_GATHER!r}")
+        if self.CHECKPOINT_COMPRESS not in (0, 1):
+            raise ValueError(
+                f"CHECKPOINT_COMPRESS must be 0 or 1, got "
+                f"{self.CHECKPOINT_COMPRESS!r}")
         if self.RESUME not in (0, 1):
             raise ValueError(f"RESUME must be 0 or 1, got {self.RESUME!r}")
         if self.RESUME and not (self.CHECKPOINT_EVERY
@@ -489,8 +558,9 @@ class Params:
         return int(self.STEP_RATE * i)
 
     @classmethod
-    def from_file(cls, config_file: str) -> "Params":
-        return cls().setparams(config_file)
+    def from_file(cls, config_file: str,
+                  validate: bool = True) -> "Params":
+        return cls().setparams(config_file, validate=validate)
 
     @classmethod
     def from_text(cls, text: str) -> "Params":

@@ -1,0 +1,211 @@
+"""Batched per-tick RNG plan for the ring-exchange steps.
+
+**The problem.**  The ring step consumes half a dozen independent random
+streams per tick — gossip-shift draws, per-shift drop masks, entry
+thinning, control-plane drop coins, the seed-burst coin, probe- and
+ack-leg coins.  Each was drawn at its use site with its own
+``jax.random.uniform``/``bernoulli`` call, so XLA lowers one threefry
+expansion per call: the round-4 HLO census at 1M_s16 attributed ~9G
+element-ops/tick to threefry fusions, one of the two remaining suspects
+for the unexplained ~100 ms/tick (PERF.md "Round-5 levers").
+
+**The fix.**  Same keys, same bits, fewer invocations: every draw keeps
+the key derivation the scattered code used (``split(key, 8)``,
+``fold_in(k_drop, j)``, …), but draws of equal FLAT element count are
+stacked and produced by ONE vmapped ``jax.random.uniform`` over the
+stacked key tensor.  vmap of the threefry primitive batches into a
+single larger invocation, and a vmapped draw is defined to equal the
+per-key draw — so the streams are bit-for-bit the scattered ones (the
+whole trajectory stays pinned against the natural path;
+tests/test_rng_plan.py).  Grouping is by flat count because threefry's
+counter pairing depends on the total draw size: ``uniform(k, (n, s))``
+equals ``uniform(k, (n*s,)).reshape(n, s)`` (same flat stream — the
+contract tpu_hash_folded already relies on) but NOT a prefix of a
+longer draw, so only same-size draws may share an invocation.
+
+**Modes** (``RNG_MODE`` conf key, resolved into ``HashConfig.rng_mode``):
+
+* ``scattered`` — one threefry per draw site, the pre-plan lowering.
+  Kept as the A/B arm for the ladder rungs (``1M_s16_onegather``
+  isolates the gather consolidation on this arm) and the bit-exactness
+  pins.
+* ``batched`` (default) — the grouped vmapped draws above.
+* ``hoisted`` — opt-in, chunked runs only (``CHECKPOINT_EVERY`` > 0):
+  the whole segment's plans are pre-drawn as ``[K, ...]`` tensors by
+  vmapping the builder over the segment's tick keys, so RNG leaves the
+  per-tick critical path entirely (the scan consumes slices).  Memory
+  cost is O(K * fanout * N * S) floats — pick CHECKPOINT_EVERY
+  accordingly (README).
+
+The drop coins are stored as uniforms, not booleans: ``bernoulli(key,
+p, shape)`` is definitionally ``uniform(key, shape, f32) < p``
+(jax._src.random._bernoulli), so comparing the planned uniform against
+``p`` at the use site reproduces the coin bit-for-bit — and keeps the
+plan valid for the dynamic-knob sweeps where ``p`` is traced
+(sweeps/phase.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def batched_uniforms(requests, batched: bool = True):
+    """Draw ``[(key, shape), ...]`` uniforms; one threefry per flat-count
+    group when ``batched`` (one per request otherwise).  Returns the
+    draws FLAT (callers reshape to their layout — natural or folded —
+    which cannot change the bits, by the flat-count contract above)."""
+    out = [None] * len(requests)
+    if not batched:
+        for i, (k, shape) in enumerate(requests):
+            out[i] = jax.random.uniform(k, shape).reshape(-1)
+        return out
+    groups: dict = {}
+    for i, (_, shape) in enumerate(requests):
+        groups.setdefault(math.prod(shape), []).append(i)
+    for cnt, idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = jax.random.uniform(requests[i][0], (cnt,))
+            continue
+        keys = jnp.stack([requests[i][0] for i in idxs])
+        flat = jax.vmap(lambda k: jax.random.uniform(k, (cnt,)))(keys)
+        for row, i in enumerate(idxs):
+            out[i] = flat[row]
+    return out
+
+
+_EMPTY = None   # placeholder builder below keeps pytree structure static
+
+
+def _empty():
+    return jnp.zeros((0,), jnp.float32)
+
+
+class RingRng(NamedTuple):
+    """One tick's random material for the ring step (flat arrays; every
+    consumer reshapes to its own layout).  Fields are zero-length
+    placeholders when the config doesn't consume that stream, so the
+    pytree structure is static across modes and the whole tuple can ride
+    ``lax.scan`` xs in hoisted mode."""
+    shift_draw: jax.Array   # [k_max] i32 — shift values, or table indices
+    #                         when SHIFT_SET (the raw randint draw)
+    thin_u: jax.Array       # [N*S] f32 entry-thinning uniforms (g < s)
+    gossip_u: jax.Array     # [k_max, N*S] f32 per-shift drop coins
+    ctrl_u: jax.Array       # [2*N] f32 control-plane drop coins
+    burst_u: jax.Array      # [cap*S] f32 seed-burst drop coins
+    probe_u: jax.Array      # [N*P] f32 probe-leg (issue-time) drop coins
+    ack_u: jax.Array        # [N*P] f32 ack-leg drop coins
+
+
+def hash_ring_rng(key, *, n: int, s: int, g: int, k_max: int, p_cnt: int,
+                  seed_rows: int, shift_set: int, use_drop: bool,
+                  need_ctrl: bool, need_burst: bool,
+                  batched: bool = True) -> RingRng:
+    """The single-chip ring step's per-tick plan (tpu_hash.make_step ring
+    branch and its folded twin — identical keys and flat counts, so the
+    two layouts stay bit-exact on the same seed).
+
+    Key derivation is EXACTLY the scattered step's: ``split(key, 8)`` to
+    ``(k_targets, k_entries, k_drop, k_ctrl, k_drop_p, k_shifts, k_ack1,
+    k_ack2)``, per-shift drop keys ``fold_in(k_drop, j)``, the seed-burst
+    coin on raw ``k_drop`` (ring mode's ``k_drop_s``)."""
+    (_k_targets, k_entries, k_drop, k_ctrl, _k_drop_p, k_shifts,
+     k_ack1, k_ack2) = jax.random.split(key, 8)
+
+    if shift_set:
+        shift_draw = jax.random.randint(k_shifts, (k_max,), 0, shift_set)
+    else:
+        shift_draw = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+
+    req = []
+    slots = {}
+
+    def want(name, k, shape, when=True):
+        if when:
+            slots[name] = len(req)
+            req.append((k, shape))
+
+    want("thin", k_entries, (n, s), g < s)
+    if use_drop:
+        for j in range(k_max):
+            want(f"gossip{j}", jax.random.fold_in(k_drop, j), (n, s))
+        want("ctrl", k_ctrl, (2, n), need_ctrl)
+        want("burst", k_drop, (seed_rows, s), need_burst)
+        want("probe", k_ack1, (n, p_cnt), p_cnt > 0)
+        want("ack", k_ack2, (n, p_cnt), p_cnt > 0)
+    drawn = batched_uniforms(req, batched=batched)
+
+    def got(name):
+        return drawn[slots[name]] if name in slots else _empty()
+
+    gossip = ([drawn[slots[f"gossip{j}"]] for j in range(k_max)]
+              if use_drop and k_max > 0 and "gossip0" in slots else [])
+    return RingRng(
+        shift_draw=shift_draw,
+        thin_u=got("thin"),
+        gossip_u=(jnp.stack(gossip) if gossip
+                  else jnp.zeros((0, 0), jnp.float32)),
+        ctrl_u=got("ctrl"),
+        burst_u=got("burst"),
+        probe_u=got("probe"),
+        ack_u=got("ack"),
+    )
+
+
+def sharded_ring_rng(key, me, *, n: int, n_local: int, s: int, g: int,
+                     k_max: int, p_cnt: int, seed_rows: int,
+                     use_drop: bool, cold_join: bool,
+                     batched: bool = True) -> RingRng:
+    """The sharded ring step's plan (tpu_hash_sharded
+    make_ring_sharded_step and its folded twin), built INSIDE shard_map:
+    per-shard streams from ``fold_in(key, me)`` / ``split(key_l, 4)``,
+    the replicated streams from the shared tick key (shifts at fold_in
+    0x517F, cold-join control at 0xC281, burst at 0xB125) — exactly the
+    scattered derivations."""
+    key_l = jax.random.fold_in(key, me)
+    k_entries, k_probe_drop, k_ack2, k_dropg = jax.random.split(key_l, 4)
+    k_shifts = jax.random.fold_in(key, 0x517F)
+    shift_draw = jax.random.randint(k_shifts, (k_max,), 1, max(n, 2))
+
+    req = []
+    slots = {}
+
+    def want(name, k, shape, when=True):
+        if when:
+            slots[name] = len(req)
+            req.append((k, shape))
+
+    want("thin", k_entries, (n_local, s), g < s)
+    if use_drop:
+        for j in range(k_max):
+            want(f"gossip{j}", jax.random.fold_in(k_dropg, j),
+                 (n_local, s))
+        want("ctrl", jax.random.fold_in(key, 0xC281), (2, n), cold_join)
+        want("burst", jax.random.fold_in(key, 0xB125), (seed_rows, s),
+             cold_join)
+        want("probe", k_probe_drop, (n_local, p_cnt), p_cnt > 0)
+        want("ack", k_ack2, (n_local, p_cnt), p_cnt > 0)
+    drawn = batched_uniforms(req, batched=batched)
+
+    def got(name):
+        return drawn[slots[name]] if name in slots else _empty()
+
+    gossip = ([drawn[slots[f"gossip{j}"]] for j in range(k_max)]
+              if use_drop and k_max > 0 and "gossip0" in slots else [])
+    return RingRng(
+        shift_draw=shift_draw,
+        thin_u=got("thin"),
+        gossip_u=(jnp.stack(gossip) if gossip
+                  else jnp.zeros((0, 0), jnp.float32)),
+        ctrl_u=got("ctrl"),
+        burst_u=got("burst"),
+        probe_u=got("probe"),
+        ack_u=got("ack"),
+    )

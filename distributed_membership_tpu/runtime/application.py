@@ -26,25 +26,22 @@ def run_conf(conf_path: str, backend: str | None = None,
              checkpoint_every: int | None = None,
              checkpoint_dir: str | None = None,
              resume: bool | None = None) -> RunResult:
-    params = Params.from_file(conf_path)
-    override = False
+    # Validation runs AFTER the CLI overrides merge: cross-field rules
+    # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
+    # effective config, not the conf file alone.
+    params = Params.from_file(conf_path, validate=False)
     if backend is not None:
         params.BACKEND = backend
-        override = True
     # Crash-recovery knobs (runtime/checkpoint.py): CLI overrides win over
     # the conf's CHECKPOINT_* / RESUME keys so an operator can resume a
     # run whose conf predates the checkpoint keys.
     if checkpoint_every is not None:
         params.CHECKPOINT_EVERY = checkpoint_every
-        override = True
     if checkpoint_dir is not None:
         params.CHECKPOINT_DIR = checkpoint_dir
-        override = True
     if resume is not None:
         params.RESUME = int(resume)
-        override = True
-    if override:
-        params.validate()
+    params.validate()
     result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
     result.log.flush(out_dir)
     if not result.extra.get("aggregate"):

@@ -55,7 +55,7 @@ CRASH_ENV = "DM_CRASH_AT_TICK"
 # never affect per-tick math; bit-exactness is pinned across chunkings).
 _IDENTITY_EXCLUDE = frozenset(
     {"globaltime", "dropmsg", "CHECKPOINT_EVERY", "CHECKPOINT_DIR",
-     "RESUME"})
+     "RESUME", "CHECKPOINT_COMPRESS"})
 
 
 def params_identity(params: Params) -> str:
@@ -188,10 +188,14 @@ def _manifest_base(params: Params, seed: int, total: int,
 
 
 def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
-                     carry_leaves: list, payload: dict) -> None:
+                     carry_leaves: list, payload: dict,
+                     compress: bool = False) -> None:
     """One versioned snapshot: ``ckpt_<tick>.npz`` (atomic write-rename),
     then the manifest pointing at it (atomic too — a crash between the
-    two leaves the previous manifest valid)."""
+    two leaves the previous manifest valid).  Runs on the chunked
+    driver's background writer thread (one worker, so manifest
+    read-modify-writes stay sequential); ``compress`` selects
+    ``np.savez_compressed`` (CHECKPOINT_COMPRESS)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     fname = f"ckpt_{tick:08d}.npz"
     arrays = {f"c{i}": np.asarray(leaf)
@@ -200,7 +204,7 @@ def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
 
     def _write_npz(tmp):
         with open(tmp, "wb") as fh:
-            np.savez(fh, **arrays)
+            (np.savez_compressed if compress else np.savez)(fh, **arrays)
 
     _atomic_write(os.path.join(ckpt_dir, fname), _write_npz)
     shash = state_hash(carry_leaves)
@@ -292,7 +296,7 @@ def _crash_tick() -> Optional[int]:
 
 def chunked_run(params: Params, plan, seed: int, total: int, *,
                 init_carry, segment_fn, collect_events: bool,
-                compact_fn=None, event_type=None):
+                compact_fn=None, event_type=None, finalize=None):
     """Run the tick loop in ``CHECKPOINT_EVERY``-tick segments.
 
     ``init_carry()`` builds the fresh device carry; ``segment_fn(carry,
@@ -302,6 +306,21 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
     pass ``compact_fn`` (per-segment host flush into
     :class:`CompactEvents`); aggregate runs pass ``event_type`` (the
     per-tick outputs are scalars, concatenated field-wise).
+    ``finalize(carry, acc) -> (carry, acc)``, when given, runs once
+    after the LAST segment (also on a resume that finds the run already
+    complete) — the chunked home of run-total epilogues that ride the
+    monolithic scan's tail on the unchunked path (tpu_hash's
+    PROBE_IO approx_lag counter correction).
+
+    Checkpoint writes are double-buffered: the host ``np.savez`` of
+    segment ``i`` runs on a background writer thread while segment
+    ``i+1`` is dispatched to the device, with a completion barrier at
+    the following boundary — so the measured snapshot overhead is the
+    device→host pull plus whatever write time the next segment's
+    compute fails to hide (BENCH_CHECKPOINT re-measures it).  Durability
+    is unchanged one segment back: a hard kill can lose only the
+    in-flight snapshot, whose predecessor manifest stays valid (the
+    same guarantee a kill mid-``np.savez`` always had).
 
     Returns ``(final_carry, events)`` with ``events`` a
     :class:`CompactEvents` (full mode) or ``event_type`` of ``[T]``
@@ -318,6 +337,7 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
     if (compact_fn is None) == (event_type is None):
         raise ValueError("pass exactly one of compact_fn/event_type")
     ckpt_dir = params.CHECKPOINT_DIR or None
+    compress = bool(params.CHECKPOINT_COMPRESS)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
@@ -346,34 +366,70 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
             elif start > 0:
                 acc = tuple(payload[f"s{i}"] for i in range(4))
 
-    crash_at = _crash_tick()
-    for a in range(start, total, every):
-        if crash_at is not None and a >= crash_at:
-            raise RuntimeError(
-                f"injected crash at tick {a} ({CRASH_ENV}={crash_at}); "
-                f"last durable checkpoint: "
-                f"{manifest_tick(ckpt_dir) or 'none'}")
-        b = min(a + every, total)
-        carry, ev = segment_fn(carry, ticks[a:b], keys[a:b], start_ticks,
-                               fail_mask, fail_time, drop_lo, drop_hi)
-        # Per-segment flush: events leave the device NOW, so full-mode
-        # device memory is O(every * N * M), and the carry lands on host
-        # for the snapshot.
-        carry = jax.tree.map(np.asarray, carry)
-        ev = jax.tree.map(np.asarray, ev)
-        if compact_fn is not None:
-            acc = concat_compact([acc, compact_fn(ev, a)])
-            payload = {"joins": acc.joins, "removes": acc.removes,
-                       "sent": acc.sent, "recv": acc.recv}
-        else:
-            seg = tuple(np.asarray(x) for x in ev)
-            acc = (seg if acc is None else
-                   tuple(np.concatenate([p, s]) for p, s in zip(acc, seg)))
-            payload = {f"s{i}": acc[i] for i in range(4)}
-        if ckpt_dir:
-            _save_checkpoint(ckpt_dir, base,
-                             b, jax.tree_util.tree_leaves(carry), payload)
+    # Background writer: one worker thread so snapshot writes serialize
+    # (the manifest is read-modify-write) while overlapping the next
+    # segment's device work; `pending` holds the single in-flight write.
+    executor = None
+    pending = None
+    if ckpt_dir:
+        from concurrent.futures import ThreadPoolExecutor
+        executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
 
+    def _await_writer():
+        nonlocal pending
+        if pending is not None:
+            fut, pending = pending, None
+            fut.result()    # surface writer exceptions on the main thread
+
+    crash_at = _crash_tick()
+    try:
+        for a in range(start, total, every):
+            if crash_at is not None and a >= crash_at:
+                # Flush the in-flight snapshot first so the fault
+                # injection leaves the deterministic on-disk state the
+                # tests pin (a real kill could additionally lose that
+                # one in-flight write — see the durability note above).
+                _await_writer()
+                raise RuntimeError(
+                    f"injected crash at tick {a} ({CRASH_ENV}={crash_at}); "
+                    f"last durable checkpoint: "
+                    f"{manifest_tick(ckpt_dir) or 'none'}")
+            b = min(a + every, total)
+            carry, ev = segment_fn(carry, ticks[a:b], keys[a:b],
+                                   start_ticks, fail_mask, fail_time,
+                                   drop_lo, drop_hi)
+            # Per-segment flush: events leave the device NOW, so full-mode
+            # device memory is O(every * N * M), and the carry lands on
+            # host for the snapshot.
+            carry = jax.tree.map(np.asarray, carry)
+            ev = jax.tree.map(np.asarray, ev)
+            if compact_fn is not None:
+                acc = concat_compact([acc, compact_fn(ev, a)])
+                payload = {"joins": acc.joins, "removes": acc.removes,
+                           "sent": acc.sent, "recv": acc.recv}
+            else:
+                seg = tuple(np.asarray(x) for x in ev)
+                acc = (seg if acc is None else
+                       tuple(np.concatenate([p, s])
+                             for p, s in zip(acc, seg)))
+                payload = {f"s{i}": acc[i] for i in range(4)}
+            if ckpt_dir:
+                # Barrier for the PREVIOUS write, then hand this one to
+                # the writer; the next segment's dispatch overlaps it.
+                # (Each iteration rebinds carry/acc to fresh host
+                # arrays, so the submitted snapshot is never mutated.)
+                _await_writer()
+                pending = executor.submit(
+                    _save_checkpoint, ckpt_dir, base, b,
+                    jax.tree_util.tree_leaves(carry), payload, compress)
+        _await_writer()
+    finally:
+        if executor is not None:
+            executor.shutdown(wait=True)
+
+    if finalize is not None and acc is not None and total > 0:
+        carry, acc = finalize(carry, acc)
     if compact_fn is not None:
         events = acc
     elif acc is None:        # zero-length run (total == start == 0)

@@ -46,6 +46,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 import numpy as np                                   # noqa: E402
 import jax                                           # noqa: E402
@@ -132,6 +133,13 @@ def sharded_backend_compile(params, devices, mesh_dims) -> None:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--variant", default=None)
+    ap.add_argument("--census", action="store_true",
+                    help="deviceless RNG/gather census of the 1M_s16 "
+                         "step instead of backend compiles: counts "
+                         "threefry invocations and [N, P]-class gathers "
+                         "in the traced program and asserts the round-6 "
+                         "reductions (scripts/hlo_census.py; no libtpu "
+                         "needed — runs in CI)")
     ap.add_argument("--probe", action="store_true",
                     help="only check whether libtpu can serve the "
                          "abstract topology, then exit — callers give "
@@ -142,6 +150,13 @@ def main() -> int:
                          "skips on a hung probe instead of burning its "
                          "full per-variant timeout)")
     args = ap.parse_args()
+
+    if args.census:
+        # The census is jaxpr-level (no topology/libtpu requirement) —
+        # delegate before the TPU-support gate below.
+        import hlo_census
+        sys.argv = [sys.argv[0], "--check"]
+        return hlo_census.main()
 
     devices = tpu_topology_devices()
     if devices is None:

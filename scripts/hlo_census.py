@@ -1,0 +1,178 @@
+"""Deviceless RNG/gather census of the ring step's traced program.
+
+The round-4 local AOT census attributed the 1M_s16 attribution gap to
+two op classes: threefry fusions (~9G element-ops/tick) and the [N, P]
+random-index gathers of the probe/ack pipeline.  Round 6 built the
+mitigations (ops/rng_plan.py batched draws; the _pack_probe_table
+single-gather pipeline); this module makes the structural win
+CI-verifiable WITHOUT hardware: it traces ONE step of the `tpu_hash`
+ring program at an exact geometry (default [1M, 16]) and counts, in the
+jaxpr,
+
+  * ``threefry2x32`` invocations (each is one lowered threefry
+    expansion / custom call — batching reduces the count, never the
+    drawn bits), and
+  * gather ops whose output is [N, P]-class (>= N elements — the
+    probe-leg random gathers; nothing else in the ring step gathers at
+    that size).
+
+Counting the jaxpr rather than backend HLO keeps the check platform-free
+(no libtpu, no 1M-element buffers — tracing is abstract), and the
+primitives counted map 1:1 onto the lowered custom-calls/gathers.
+
+Used by ``scripts/aot_backend_compile.py --census`` (prints the JSON)
+and asserted by tests/test_hlo_census.py: the default
+(batched + packed) program must show exactly ONE probe-leg gather and
+strictly fewer threefry invocations than the scattered arm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def census_params(n: int, s: int, *, rng_mode: str = "batched",
+                  probe_gather: str = "packed", drops: bool = False,
+                  probe_io: str = "auto"):
+    """The ladder's 1M_s16 step config (profile_step.py defaults) at
+    (n, s), with the round-6 lowering knobs exposed.  ``drops`` arms the
+    msgdrop-class coin streams — the regime where the batched plan
+    collapses the most invocations (the drop-free step draws only the
+    thinning + shift streams)."""
+    from distributed_membership_tpu.config import Params
+
+    g = max(s // 4, 1)
+    probes = max(s // 8, 1)
+    drop_keys = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\n" if drops
+                 else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    return Params.from_text(
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
+        f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
+        f"TFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+        f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+        f"FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
+        f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
+        f"PROBE_IO: {probe_io}\nBACKEND: tpu_hash\n")
+
+
+def _walk_eqns(jaxpr, visit):
+    """Visit every eqn recursively (pjit/scan/cond sub-jaxprs included)."""
+    from jax._src import core
+
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for sub in vals:
+                if isinstance(sub, core.ClosedJaxpr):
+                    _walk_eqns(sub.jaxpr, visit)
+                elif isinstance(sub, core.Jaxpr):
+                    _walk_eqns(sub, visit)
+
+
+def step_census(params) -> dict:
+    """Trace one ring step for ``params`` (abstract shapes only — no
+    device buffers) and count the two flagged op classes."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _get_step_and_init, make_config)
+
+    n = params.EN_GPSZ
+    cfg = make_config(params, collect_events=False, fail_ids=(0,))
+    step, init = _get_step_and_init(cfg, warm=True)
+
+    key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    state = jax.eval_shape(init, key_sds)
+    i32 = jnp.int32
+    inp = (jax.ShapeDtypeStruct((), i32), key_sds,
+           jax.ShapeDtypeStruct((n,), i32),
+           jax.ShapeDtypeStruct((n,), jnp.bool_),
+           jax.ShapeDtypeStruct((), i32),
+           jax.ShapeDtypeStruct((), i32),
+           jax.ShapeDtypeStruct((), i32))
+    traced = jax.jit(lambda st, inp: step(st, inp)).trace(state, inp)
+
+    counts = {"threefry_calls": 0, "big_gathers": 0,
+              "big_gather_shapes": []}
+
+    def visit(eqn):
+        name = eqn.primitive.name
+        # Each random-bits draw is one threefry expansion at lowering:
+        # the traced program carries it as `random_bits` (typed-key
+        # path) or `threefry2x32` (raw counters) depending on the jax
+        # version/impl — count both spellings.
+        if name in ("threefry2x32", "random_bits"):
+            counts["threefry_calls"] += 1
+        elif name == "gather":
+            size = 1
+            for d in eqn.outvars[0].aval.shape:
+                size *= d
+            if size >= n:
+                counts["big_gathers"] += 1
+                counts["big_gather_shapes"].append(
+                    list(eqn.outvars[0].aval.shape))
+
+    _walk_eqns(traced.jaxpr.jaxpr, visit)
+    counts["n"] = n
+    counts["s"] = params.VIEW_SIZE
+    return counts
+
+
+def full_census(n: int = 1 << 20, s: int = 16) -> dict:
+    """The four-arm census the regression test pins: the default
+    (batched + packed) program against the pre-round-6
+    (scattered + split) arm, drop-free AND with the msgdrop coin
+    streams armed."""
+    out = {"n": n, "s": s}
+    for drops in (False, True):
+        for rng_mode, probe_gather in (("batched", "packed"),
+                                       ("scattered", "split")):
+            tag = (f"{'drops' if drops else 'nodrop'}_"
+                   f"{rng_mode}_{probe_gather}")
+            c = step_census(census_params(
+                n, s, rng_mode=rng_mode, probe_gather=probe_gather,
+                drops=drops))
+            out[tag] = {k: c[k] for k in ("threefry_calls", "big_gathers",
+                                          "big_gather_shapes")}
+    return out
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 20)
+    ap.add_argument("--view", type=int, default=16)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero unless the default program shows "
+                         "exactly one probe-leg gather and fewer "
+                         "threefry invocations than the scattered arm")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    out = full_census(args.n, args.view)
+    print(json.dumps(out))
+    if args.check:
+        ok = (out["nodrop_batched_packed"]["big_gathers"] == 1
+              and out["drops_batched_packed"]["big_gathers"] == 1
+              and out["drops_batched_packed"]["threefry_calls"]
+              < out["drops_scattered_split"]["threefry_calls"]
+              and out["nodrop_scattered_split"]["big_gathers"] > 1)
+        if not ok:
+            print("census regression: expected one probe-leg gather and "
+                  "reduced threefry count on the default arm",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,9 @@ def _example_scan_args(params, plan, ticks):
 def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                fanout: int = 3, cost: bool = False,
                fused_gossip: bool = False, folded: bool = False,
-               prng: str = "threefry2x32", shift_set: int = 0) -> dict:
+               prng: str = "threefry2x32", shift_set: int = 0,
+               rng_mode: str = "batched",
+               probe_gather: str = "packed") -> dict:
     import random as _pyrandom
 
     import jax
@@ -58,6 +60,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
         f"PRNG_IMPL: {prng}\nSHIFT_SET: {shift_set}\n"
+        f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
         f"BACKEND: tpu_hash\n")
     params = Params.from_text(text)
     plan = make_plan(params, _pyrandom.Random("app:0"))
@@ -143,6 +146,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
         "prng": prng, "shift_set": shift_set,
+        "rng_mode": rng_mode, "probe_gather": probe_gather,
         "fanout": cfg.fanout, "probes": cfg.probes,
         "platform": jax.default_backend(),
         # wall_seconds is a SECOND run on the warm jit cache; compile time
@@ -179,6 +183,18 @@ def main() -> int:
                          "(0 = off; the node-minor roll mitigation)")
     ap.add_argument("--prng", default="threefry2x32",
                     choices=["threefry2x32", "rbg", "unsafe_rbg"])
+    ap.add_argument("--rng-mode", default="batched",
+                    choices=["batched", "scattered"],
+                    help="ring RNG lowering (ops/rng_plan): batched = "
+                         "one vmapped threefry per same-size draw "
+                         "group (default), scattered = per-site draws "
+                         "(the pre-round-6 A/B arm; bit-identical "
+                         "streams)")
+    ap.add_argument("--probe-gather", default="packed",
+                    choices=["packed", "split"],
+                    help="probe/ack pipeline gather lowering: packed = "
+                         "one combined [N, 2P] gather (default), split "
+                         "= the two-gather pre-round-6 arm (bit-exact)")
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
@@ -197,7 +213,9 @@ def main() -> int:
                              fused, args.fanout, cost=args.cost,
                              fused_gossip=args.fused_gossip == "on",
                              folded=args.folded == "on", prng=args.prng,
-                             shift_set=args.shift_set)
+                             shift_set=args.shift_set,
+                             rng_mode=args.rng_mode,
+                             probe_gather=args.probe_gather)
             print(json.dumps(rec), flush=True)
     return 0
 

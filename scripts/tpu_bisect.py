@@ -104,15 +104,43 @@ def run_micro(n: int, s: int) -> dict:
     v = jnp.arange(n, dtype=jnp.int32)
     bank("vec_n_add", _micro(lambda a: a + 1, v), 2 * n * 4 / 1e9)
     # Random-index gather, the probe/ack pipeline's access pattern: the
-    # optimized 1M_s16 HLO has four [N, P] gathers from [N] tables per
-    # tick (hb_ack = vec[id2], act[tgt1], will_flush[tgt1]) — random
-    # access is the op class TPUs handle worst, and the local AOT census
-    # cannot price it (XLA's gather cost model is nominal).  P=2 at the
-    # north-star config.
+    # round-4 1M_s16 HLO had four [N, P]-class gathers from [N] tables
+    # per tick (hb_ack = vec[id2], act[tgt1], will_flush[tgt1] + the
+    # lag variant's stack); round 6 consolidated them into ONE packed
+    # [N, 2P] gather (PROBE_GATHER, scripts/hlo_census.py asserts the
+    # count).  Random access is the op class TPUs handle worst, and the
+    # local AOT census cannot price it (XLA's gather cost model is
+    # nominal).  P=2 at the north-star config.
     p_cnt = max(s // 8, 1)
     idx2 = jax.random.randint(key, (n, p_cnt), 0, n)
     bank("gather_np_from_n", _micro(lambda a, i: a[i], v, idx2),
          (2 * n * p_cnt + n) * 4 / 1e9)   # idx read + out write + table
+    # Round-6 gather consolidation, priced directly: the probe leg's two
+    # [N, P] gathers (ack value + counter bits) vs ONE combined [N, 2P]
+    # gather over the concatenated index tensor (PROBE_GATHER packed,
+    # tpu_hash._pack_probe_table).
+    idx2b = jax.random.randint(jax.random.fold_in(key, 1), (n, p_cnt),
+                               0, n)
+    idx_cat = jnp.concatenate([idx2, idx2b], axis=1)
+    bank("gather_np_two", _micro(lambda a, i, j: (a[i], a[j]),
+                                 v, idx2, idx2b),
+         (4 * n * p_cnt + 2 * n) * 4 / 1e9)
+    bank("gather_n2p_cat", _micro(lambda a, i: a[i], v, idx_cat),
+         (4 * n * p_cnt + n) * 4 / 1e9)
+    # Round-6 RNG plan, priced directly: the droppy step's (1 + fanout)
+    # same-size [N, S] coin draws as per-site threefry invocations vs
+    # ONE vmapped batched invocation (ops/rng_plan.batched_uniforms).
+    from distributed_membership_tpu.ops.rng_plan import batched_uniforms
+    keys4 = [jax.random.fold_in(key, 10 + j) for j in range(4)]
+    k4 = jnp.stack(keys4)
+    bank("uniform_ns_x4_scattered", _micro(
+        lambda kk: tuple(batched_uniforms(
+            [(kk[i], (n, s)) for i in range(4)], batched=False)), k4),
+        4 * plane_gb)
+    bank("uniform_ns_x4_batched", _micro(
+        lambda kk: tuple(batched_uniforms(
+            [(kk[i], (n, s)) for i in range(4)], batched=True)), k4),
+        4 * plane_gb)
     # Dynamic lane roll of the [N, S] plane (probe window + gossip column
     # alignment): minor-dim rotation by a traced scalar.
     sh = jnp.asarray(3, jnp.int32)

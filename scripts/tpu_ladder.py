@@ -128,6 +128,18 @@ LADDER = [
     # SHIFT_SET x FOLDED: static-table shifts make every folded roll
     # static — the zero-dynamic-roll unfused candidate at S=16.
     ("1M_s16_folded_sw16", 1 << 20, 16, 60, "folded_sw16", 1200),
+    # Round-6 mitigations for the two remaining census suspects,
+    # ISOLATED against the banked natural rows: 'rngplan' runs the
+    # batched RNG plan with the legacy split probe gather (prices the
+    # threefry-consolidation alone), 'onegather' the packed single
+    # [N, 2P] probe gather with scattered RNG (prices the gather
+    # consolidation alone).  Both are bit-exact with the natural step
+    # (tests/test_rng_plan.py) — default runs now carry BOTH, so these
+    # rungs also decompose any delta a re-measured 1M_s16 shows.
+    ("65k_s16_rngplan",  1 << 16, 16, 150, "rngplan",   240),
+    ("65k_s16_onegather", 1 << 16, 16, 150, "onegather", 240),
+    ("1M_s16_rngplan",   1 << 20, 16,  60, "rngplan",   600),
+    ("1M_s16_onegather", 1 << 20, 16,  60, "onegather", 600),
     # Same-window s64 slope re-measure: the banked 262k (17:41Z) and
     # 524k (01:17Z) rows came from different relay windows with
     # IDENTICAL compiled programs (PERF.md compile diff) — adjacent
@@ -213,6 +225,11 @@ CPU_ONLY_PIN_MODES = {
     "sw16": "cpu_only:tests/test_shift_set.py (lax.switch static-roll "
             "delivery vs dynamic path; no on-chip equivalence run)",
     "folded_sw16": "cpu_only:tests/test_shift_set.py+tests/test_folded.py",
+    "rngplan": "cpu_only:tests/test_rng_plan.py (batched vmapped "
+               "threefry vs per-site draws; bit-equal streams by the "
+               "vmap contract)",
+    "onegather": "cpu_only:tests/test_rng_plan.py+tests/test_probe_io.py "
+                 "(packed combined probe gather vs split two-gather)",
 }
 
 
@@ -274,7 +291,13 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                else "off",
                "--shift-set",
                "16" if fused in ("sw16", "folded_sw16") else "0",
-               "--prng", "rbg" if fused == "rbg" else "threefry2x32"]
+               "--prng", "rbg" if fused == "rbg" else "threefry2x32",
+               # Isolation arms for the round-6 census mitigations: each
+               # turns ONE of them off against the new defaults.
+               "--rng-mode",
+               "scattered" if fused == "onegather" else "batched",
+               "--probe-gather",
+               "split" if fused == "rngplan" else "packed"]
     # Timing rungs (profile_step) checkpoint their scans so an interrupted
     # attempt RESUMES from the last durable segment; the special-script
     # rungs (correctness/layout/bisect) still get the retry/backoff loop,
@@ -368,12 +391,14 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
-    # 'rbg' swaps the key-stream impl and 'sw16' the shift-draw
-    # distribution on the plain jnp step — no Pallas kernel in the
-    # program, so no correctness family gates them (protocol validity
-    # pinned in tests/test_hash_backend.py and tests/test_shift_set.py).
-    if (mode in ("off", "rbg", "sw16") or mode in BISECT_PHASES
-            or corr is None):
+    # 'rbg' swaps the key-stream impl, 'sw16' the shift-draw
+    # distribution, and 'rngplan'/'onegather' the RNG/gather lowering on
+    # the plain jnp step — no Pallas kernel in the program, so no
+    # correctness family gates them (protocol validity pinned in
+    # tests/test_hash_backend.py, tests/test_shift_set.py,
+    # tests/test_rng_plan.py).
+    if (mode in ("off", "rbg", "sw16", "rngplan", "onegather")
+            or mode in BISECT_PHASES or corr is None):
         return False
     # 'folded_sw16' carries no Pallas kernel but still needs the folded
     # LAYOUT's banked bit-exactness family clean: it falls through to

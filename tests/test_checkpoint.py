@@ -292,11 +292,20 @@ def test_config_gates():
         Params.from_text(base + "BACKEND: emul\nCHECKPOINT_EVERY: 50\n")
     with pytest.raises(ValueError, match="RESUME"):
         Params.from_text(base + "BACKEND: tpu\nRESUME: 1\n")
-    with pytest.raises(ValueError, match="approx_lag"):
+    # approx_lag x CHECKPOINT_EVERY composes since round 6 (the lag
+    # state rides the carry; the counter epilogue moved to the chunked
+    # driver's finalize hook) — the old incompatibility must NOT raise.
+    Params.from_text(
+        base + "BACKEND: tpu_hash\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
+        "PROBES: 2\nTFAIL: 16\nTREMOVE: 64\nJOIN_MODE: warm\n"
+        "EXCHANGE: ring\nPROBE_IO: approx_lag\n"
+        "CHECKPOINT_EVERY: 50\n")
+    # RNG_MODE hoisted is segment-scoped and single-chip-ring only.
+    with pytest.raises(ValueError, match="hoisted"):
+        Params.from_text(base + "BACKEND: tpu_hash\nRNG_MODE: hoisted\n")
+    with pytest.raises(ValueError, match="hoisted"):
         Params.from_text(
-            base + "BACKEND: tpu_hash\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
-            "PROBES: 2\nTFAIL: 16\nTREMOVE: 64\nJOIN_MODE: warm\n"
-            "EXCHANGE: ring\nPROBE_IO: approx_lag\n"
+            base + "BACKEND: tpu_sparse\nRNG_MODE: hoisted\n"
             "CHECKPOINT_EVERY: 50\n")
     with pytest.raises(ValueError, match="CHECKPOINT_EVERY"):
         Params.from_text(base + "BACKEND: tpu\nCHECKPOINT_EVERY: -1\n")

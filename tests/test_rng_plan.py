@@ -1,0 +1,265 @@
+"""Batched RNG plan + single-gather probe pipeline (round-6 tentpole).
+
+Two lowering knobs changed the ring step's compiled program without
+being allowed to change a single bit of any trajectory:
+
+  * ``RNG_MODE`` (ops/rng_plan.py) — 'batched' stacks same-size draws
+    into ONE vmapped threefry over the stacked keys; 'scattered' is the
+    pre-round-6 per-site lowering; 'hoisted' pre-draws a whole
+    CHECKPOINT_EVERY segment outside the scan.
+  * ``PROBE_GATHER`` — 'packed' rides ack value + will-flush + act +
+    counter bits on ONE per-target gather (tpu_hash._pack_probe_table);
+    'split' keeps the two-gather form.
+
+This module pins the bit-exactness contract on every ring twin
+(natural, folded, sharded natural, sharded folded; with and without
+drops) by running the A/B arms against the pre-round-6
+(scattered + split) arm — which IS the pre-PR step lowering — plus the
+plan's unit contract and the hoisted/chunked composition.
+
+Tiering: the tier-1 wall-clock budget keeps the core pins (natural
+drops pair, sharded pair, hoisted + kill/resume, units) in `-m 'not
+slow'`; the extended matrix (folded, lag, nodrop, forced-approx,
+isolation arms, sharded folded/approx) carries @pytest.mark.slow and
+runs with a plain `pytest tests/` — run it whenever the ring draw sites
+or the probe gather change.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+
+# Short runs with the drop window pinned OPEN for most of them
+# (DROP_START 10): the pins compare bit trajectories, and every coin
+# stream must be ACTIVE to catch an application bug, not just drawn.
+CONF = (
+    "MAX_NNB: 256\nSINGLE_FAILURE: 1\nDROP_MSG: {drop}\n"
+    "MSG_DROP_PROB: {p}\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+    "FANOUT: 3\nTFAIL: 16\nTREMOVE: 48\nTOTAL_TIME: 50\nFAIL_TIME: 25\n"
+    "DROP_START: 10\nDROP_STOP: 45\n"
+    "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n")
+# Sharded folded needs L = N/8 divisible by 128/P = 64.
+# L = N/8 = 64 rows/shard: the smallest folding both P=2 and S=16 accept.
+CONF_SHARDED_FOLDED = CONF.replace("MAX_NNB: 256", "MAX_NNB: 512")
+LEGACY = "RNG_MODE: scattered\nPROBE_GATHER: split\n"
+
+
+def _conf(base, drops):
+    return base.format(drop=int(drops), p=0.1 if drops else 0)
+
+
+_MEMO = {}
+
+
+def _run(backend, text, seed=5):
+    """Memoized by conf text: several pins share their reference arm
+    (and the jit runner cache already shares compiles per config), so
+    each distinct program runs once per module."""
+    key = (backend, text, seed)
+    if key not in _MEMO:
+        r = get_backend(backend)(Params.from_text(text), seed=seed)
+        _MEMO[key] = (r.extra["detection_summary"], np.asarray(r.sent),
+                      np.asarray(r.recv))
+    return _MEMO[key]
+
+
+def _assert_same(a, b):
+    assert a[0] == b[0]
+    np.testing.assert_array_equal(a[1], b[1])
+    np.testing.assert_array_equal(a[2], b[2])
+
+
+@pytest.mark.quick
+def test_batched_uniforms_bit_exact_and_grouped():
+    """The unit contract: grouped vmapped draws equal the per-key draws
+    bit for bit, across mixed flat counts (same-count draws share one
+    group, the rest draw alone)."""
+    import jax
+
+    from distributed_membership_tpu.ops.rng_plan import batched_uniforms
+
+    key = jax.random.PRNGKey(3)
+    ks = list(jax.random.split(key, 5))
+    # (64, 2) and (2, 64) share flat count 128; (7,) is its own group.
+    reqs = [(ks[0], (64, 2)), (ks[1], (2, 64)), (ks[2], (7,)),
+            (ks[3], (64, 2)), (ks[4], (128,))]
+    batched = batched_uniforms(reqs, batched=True)
+    scattered = batched_uniforms(reqs, batched=False)
+    for b, s, (k, shape) in zip(batched, scattered, reqs):
+        ref = np.asarray(jax.random.uniform(k, shape)).reshape(-1)
+        np.testing.assert_array_equal(np.asarray(b), ref)
+        np.testing.assert_array_equal(np.asarray(s), ref)
+
+
+@pytest.mark.quick
+def test_rng_plan_reduces_invocations():
+    """Batched mode emits strictly fewer threefry/random-bits draws for
+    the droppy ring stream set (the census's per-step assertion lives in
+    tests/test_hlo_census.py; this is the plan-level unit twin)."""
+    import jax
+
+    from distributed_membership_tpu.ops.rng_plan import hash_ring_rng
+
+    def count(batched):
+        names = []
+
+        def walk(j):
+            from jax._src import core
+            for e in j.eqns:
+                names.append(e.primitive.name)
+                for v in e.params.values():
+                    for s in (v if isinstance(v, (tuple, list)) else (v,)):
+                        if isinstance(s, core.ClosedJaxpr):
+                            walk(s.jaxpr)
+                        elif isinstance(s, core.Jaxpr):
+                            walk(s)
+        jx = jax.make_jaxpr(lambda k: hash_ring_rng(
+            k, n=256, s=16, g=8, k_max=3, p_cnt=2, seed_rows=8,
+            shift_set=0, use_drop=True, need_ctrl=True, need_burst=True,
+            batched=batched))(jax.random.PRNGKey(0))
+        walk(jx.jaxpr)
+        return sum(1 for nm in names
+                   if nm in ("random_bits", "threefry2x32"))
+
+    assert count(True) < count(False)
+
+
+def test_natural_ring_modes_bit_exact():               # ~6 s: tier-1
+    """tpu_hash natural ring, drops armed (the full stream set): the
+    default batched+packed program reproduces the scattered+split
+    (pre-round-6) trajectory bit for bit."""
+    base = _conf(CONF, True) + "BACKEND: tpu_hash\n"
+    _assert_same(_run("tpu_hash", base + LEGACY), _run("tpu_hash", base))
+
+
+@pytest.mark.slow
+def test_isolation_arms_bit_exact():
+    """The ladder's rngplan (batched+split) and onegather
+    (scattered+packed) isolation arms — each single-knob program equals
+    the legacy arm too (the combined pin above cannot be a
+    cancellation: the knobs touch disjoint tensors, but the on-chip
+    rungs run THESE exact programs, so pin them verbatim)."""
+    base = _conf(CONF, True) + "BACKEND: tpu_hash\n"
+    ref = _run("tpu_hash", base + LEGACY)
+    _assert_same(ref, _run(
+        "tpu_hash", base + "RNG_MODE: batched\nPROBE_GATHER: split\n"))
+    _assert_same(ref, _run(
+        "tpu_hash", base + "RNG_MODE: scattered\nPROBE_GATHER: packed\n"))
+
+
+@pytest.mark.slow
+def test_natural_ring_nodrop_bit_exact():              # ~5 s: full-tier
+    """Drop-free arm (the 1M_s16 ladder shape): defaults == legacy."""
+    base = _conf(CONF, False) + "BACKEND: tpu_hash\n"
+    _assert_same(_run("tpu_hash", base + LEGACY), _run("tpu_hash", base))
+
+
+@pytest.mark.slow
+def test_lag_packed_bit_exact():                       # ~6 s: full-tier
+    """PROBE_IO approx_lag's packed arm (one u32 gather instead of the
+    [N, P, 2] stack) keeps the lag trajectory bit for bit."""
+    base = (_conf(CONF, True)
+            + "BACKEND: tpu_hash\nPROBE_IO: approx_lag\n")
+    _assert_same(_run("tpu_hash", base + LEGACY), _run("tpu_hash", base))
+
+
+@pytest.mark.slow
+def test_folded_ring_modes_bit_exact():                # ~12 s: full-tier
+    """FOLDED twin, with drops (the heavier stream set): defaults equal
+    the natural legacy arm — folded x packed x batched all compose."""
+    base = _conf(CONF, True)
+    ref = _run("tpu_hash", base + "BACKEND: tpu_hash\n" + LEGACY)
+    _assert_same(ref, _run("tpu_hash",
+                           base + "BACKEND: tpu_hash\nFOLDED: 1\n"))
+    _assert_same(ref, _run(
+        "tpu_hash", base + "BACKEND: tpu_hash\nFOLDED: 1\n" + LEGACY))
+
+
+def test_sharded_ring_modes_bit_exact():               # ~10 s: tier-1
+    """Sharded ring (virtual 8-device mesh): defaults equal the legacy
+    arm — the packed arm's SINGLE [N] all_gather (instead of three)
+    plus combined gather keeps the sharded trajectory bit-identical."""
+    base = _conf(CONF_SHARDED_FOLDED, True) + "BACKEND: tpu_hash_sharded\n"
+    _assert_same(_run("tpu_hash_sharded", base + LEGACY),
+                 _run("tpu_hash_sharded", base))
+
+
+@pytest.mark.slow
+def test_sharded_folded_and_approx_bit_exact():
+    """Extended sharded matrix: the folded sharded twin on the new
+    defaults equals the natural legacy arm, and the forced approx
+    counter branch (_credit_orphan_recvs_sharded with packed bits)
+    equals its split arm."""
+    base = _conf(CONF_SHARDED_FOLDED, True) + "BACKEND: tpu_hash_sharded\n"
+    ref = _run("tpu_hash_sharded", base + LEGACY)
+    _assert_same(ref, _run("tpu_hash_sharded", base + "FOLDED: 1\n"))
+    abase = base + "PROBE_IO: approx\n"
+    _assert_same(_run("tpu_hash_sharded", abase + LEGACY),
+                 _run("tpu_hash_sharded", abase))
+
+
+def test_exact_counters_packed_bit_exact():            # cache-hit cheap
+    """The DEFAULT exact path (PROBE_IO exact) rides the combined gather
+    too — counters, not just ack values, must be unchanged.  (At N=256
+    PROBE_IO auto already resolves exact, so these arms share the main
+    test's compiled runners.)"""
+    base = _conf(CONF, True) + "BACKEND: tpu_hash\nPROBE_IO: exact\n"
+    _assert_same(_run("tpu_hash", base + LEGACY), _run("tpu_hash", base))
+
+
+@pytest.mark.slow
+def test_approx_counters_packed_bit_exact():           # ~6 s: tier-1
+    """The >2^17-auto scale branch (PROBE_IO approx: _credit_orphan_recvs
+    + the prober-row attribution), forced at small N: packed == split —
+    the branch the 1M_s16 program actually runs."""
+    base = (_conf(CONF, True)
+            + "BACKEND: tpu_hash\nPROBE_IO: approx\n")
+    _assert_same(_run("tpu_hash", base + LEGACY), _run("tpu_hash", base))
+
+
+@pytest.mark.quick
+def test_hoisted_segment_equals_monolithic(tmp_path):
+    """RNG_MODE hoisted (chunked runs only): pre-drawn [K, ...] segment
+    RNG reproduces the monolithic batched run bit for bit."""
+    base = _conf(CONF, True) + "BACKEND: tpu_hash\n"
+    mono = _run("tpu_hash", base)
+    hoist = _run("tpu_hash", base + "CHECKPOINT_EVERY: 25\n"
+                 f"CHECKPOINT_DIR: {tmp_path}\nRNG_MODE: hoisted\n")
+    _assert_same(mono, hoist)
+
+
+def test_hoisted_kill_resume_bit_exact(tmp_path, monkeypatch):
+    """Kill a hoisted+compressed chunked run mid-flight; the resume must
+    land on the monolithic trajectory (checkpoint + RNG plan + compress
+    compose)."""
+    from distributed_membership_tpu.runtime import checkpoint as ck
+
+    base = _conf(CONF, True) + "BACKEND: tpu_hash\n"
+    mono = _run("tpu_hash", base)
+    ckdir = tmp_path / "ck"
+    keys = (f"CHECKPOINT_EVERY: 25\nCHECKPOINT_DIR: {ckdir}\n"
+            "RNG_MODE: hoisted\nCHECKPOINT_COMPRESS: 1\n")
+    monkeypatch.setenv(ck.CRASH_ENV, "25")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run("tpu_hash", base + keys)
+    monkeypatch.delenv(ck.CRASH_ENV)
+    assert ck.manifest_tick(str(ckdir)) == 25
+    res = _run("tpu_hash", base + keys + "RESUME: 1\n")
+    _assert_same(mono, res)
+
+
+def test_env_override_keys_parse():
+    """The new conf keys round-trip the parser and reject bad values."""
+    base = _conf(CONF, False) + "BACKEND: tpu_hash\n"
+    p = Params.from_text(base + "RNG_MODE: scattered\n"
+                         "PROBE_GATHER: split\nCHECKPOINT_COMPRESS: 1\n")
+    assert (p.RNG_MODE, p.PROBE_GATHER, p.CHECKPOINT_COMPRESS) == (
+        "scattered", "split", 1)
+    with pytest.raises(ValueError, match="RNG_MODE"):
+        Params.from_text(base + "RNG_MODE: nope\n")
+    with pytest.raises(ValueError, match="PROBE_GATHER"):
+        Params.from_text(base + "PROBE_GATHER: nope\n")
+    with pytest.raises(ValueError, match="CHECKPOINT_COMPRESS"):
+        Params.from_text(base + "CHECKPOINT_COMPRESS: 2\n")

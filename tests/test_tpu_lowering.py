@@ -131,8 +131,35 @@ def test_rbg_scan_lowers_for_tpu(impl):
 def test_lag_scan_lowers_for_tpu():
     """PROBE_IO approx_lag (the single-gather probe pipeline, a 1M_s16
     ladder candidate) must lower for TPU like every other variant — its
-    [N, 2]-wide combined gather is a new gather geometry."""
+    packed combined gather is a new gather geometry.  (The VARIANTS
+    above already lower the round-6 defaults — batched RNG + the packed
+    [N, 2P] probe gather — on every fused/folded shape.)"""
     p = _conf(4096, 128, False, False, False, False)
     p.PROBE_IO = "approx_lag"
     p.validate()
     _lower_for_tpu(p)
+
+
+@pytest.mark.quick
+def test_hoisted_segment_lowers_for_tpu():
+    """RNG_MODE hoisted: the chunked segment runner (vmapped RingRng
+    pre-draw feeding the scan) must make it through the TPU pipeline —
+    it is a new program shape (the scan consumes a pytree of [K, ...]
+    RNG tensors instead of keys)."""
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _get_segment_runner, _get_step_and_init)
+
+    p = _conf(1024, 16, False, False, True, True)
+    p.RNG_MODE = "hoisted"
+    p.CHECKPOINT_EVERY = 20
+    p.validate()
+    plan = make_plan(p, _pyrandom.Random("app:0"))
+    cfg = make_config(p, collect_events=False,
+                      fail_ids=plan_fail_ids(plan))
+    (ticks, keys, start_ticks, fail_mask, fail_time,
+     drop_lo, drop_hi) = plan_tensors(p, plan, 0, p.CHECKPOINT_EVERY)
+    _, init = _get_step_and_init(cfg, warm=True)
+    state = init(make_run_key(p, 7))
+    run_seg = _get_segment_runner(cfg, warm=True)
+    run_seg.trace(state, ticks, keys, start_ticks, fail_mask, fail_time,
+                  drop_lo, drop_hi).lower(lowering_platforms=("tpu",))
