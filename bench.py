@@ -32,7 +32,9 @@ Pallas kernels), BENCH_FOLDED (on = the [N/F, 128] folded layout for
 S < 128), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg seconds),
 BENCH_CHECKPOINT=K (+ BENCH_CHECKPOINT_COMPRESS=1) re-times the leg
 chunked with async-written snapshots, BENCH_RNG=1 adds the
-batched-vs-scattered threefry micro (ops/rng_plan) at the leg geometry.
+batched-vs-scattered threefry micro (ops/rng_plan) at the leg geometry,
+BENCH_TELEMETRY=1 re-times the leg with the flight recorder's in-scan
+per-tick scalars armed (TELEMETRY: scalars, observability/timeline.py).
 """
 
 from __future__ import annotations
@@ -215,6 +217,28 @@ def leg_hash(n: int, ticks: int, pin: str | None,
                                              / max(wall, 1e-9), 1),
             "checkpoint_bytes_per_snapshot": ck_bytes // max(len(kept), 1),
         }
+    # BENCH_TELEMETRY=1: price the flight recorder's in-scan per-tick
+    # scalars (TELEMETRY: scalars, observability/timeline.py) — the same
+    # leg re-timed with the telemetry reductions in the compiled step
+    # (series computed and dropped: no recorder, no disk — the pure
+    # in-scan overhead the ISSUE bounds at <= 3% on CPU at 65k_s16).
+    if os.environ.get("BENCH_TELEMETRY", "0") not in ("", "0"):
+        params_tel = Params.from_text(params_text + "TELEMETRY: scalars\n")
+        # Interleaved best-of-R pairs, min per variant: single-shot walls
+        # on a busy host swing +-10%, drowning a few-percent overhead.
+        reps = int(os.environ.get("BENCH_TELEMETRY_REPS", "3"))
+        tel_wall, _ = _timed_runs(run_scan, params_tel, plan, ticks)
+        base_best = wall
+        for _ in range(max(reps - 1, 0)):
+            b, _ = _timed_runs(run_scan, params, plan, ticks)
+            t, _ = _timed_runs(run_scan, params_tel, plan, ticks)
+            base_best = min(base_best, b)
+            tel_wall = min(tel_wall, t)
+        ckpt_fields.update({
+            "telemetry_wall_seconds": round(tel_wall, 3),
+            "telemetry_overhead_pct": round(
+                100 * (tel_wall - base_best) / max(base_best, 1e-9), 1),
+        })
     if os.environ.get("BENCH_RNG", "0") not in ("", "0"):
         ckpt_fields.update(_bench_rng_micro(
             make_config(params, collect_events=False)))

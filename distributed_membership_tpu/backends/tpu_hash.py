@@ -91,6 +91,8 @@ from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     FAST_AGG_MAX_FAILED, AggStats, init_agg, init_fast_agg, update_agg,
     update_fast_agg)
+from distributed_membership_tpu.observability.timeline import (
+    PHASE_ACK, PHASE_GOSSIP, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry)
 from distributed_membership_tpu.ops.fused_gossip import (
     gossip_fused, gossip_fused_stacked, gossip_fused_supported)
 from distributed_membership_tpu.ops.fused_receive import (
@@ -151,18 +153,19 @@ def deliver_shift(payload, r, n, s, cstride, idx):
     so every roll lowers to an aligned static copy.  Both callers share
     this one definition, so the static path cannot drift from the
     dynamic one (equality pinned in tests/test_shift_set.py)."""
-    static = isinstance(r, int)
-    rolled = jnp.roll(payload, r, axis=0)
-    s1 = ((r % s) * cstride % s if static
-          else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
-    r1 = jnp.roll(rolled, s1, axis=1)
-    if (n * STRIDE) % s == 0:
-        return r1
-    s2 = (((r - n) % s) * cstride % s if static
-          else jax.lax.rem(
-              jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s))
-    r2 = jnp.roll(rolled, s2, axis=1)
-    return jnp.where((idx >= r)[:, None], r1, r2)
+    with jax.named_scope(PHASE_GOSSIP):
+        static = isinstance(r, int)
+        rolled = jnp.roll(payload, r, axis=0)
+        s1 = ((r % s) * cstride % s if static
+              else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
+        r1 = jnp.roll(rolled, s1, axis=1)
+        if (n * STRIDE) % s == 0:
+            return r1
+        s2 = (((r - n) % s) * cstride % s if static
+              else jax.lax.rem(
+                  jax.lax.rem(jax.lax.rem(r - n, s) + s, s) * cstride, s))
+        r2 = jnp.roll(rolled, s2, axis=1)
+        return jnp.where((idx >= r)[:, None], r1, r2)
 
 
 def ptr_switch(ptr, step: int, s: int, fn, *operands, max_branches: int = 16):
@@ -351,6 +354,14 @@ class HashConfig:
     #                              per-target gather via
     #                              _pack_probe_table; 'split' keeps the
     #                              pre-round-6 two-gather form (A/B arm)
+    telemetry: bool = False      # TELEMETRY: scalars — emit the per-tick
+    #                              TickTelemetry scalar reductions
+    #                              alongside the event outputs
+    #                              (observability/timeline.py).  Every
+    #                              emission site is guarded on this flag,
+    #                              so the off program is op-identical to
+    #                              the pre-flight-recorder lowering
+    #                              (tests/test_hlo_census.py).  Ring only.
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -560,6 +571,10 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
     use_drop = dynamic_knobs or cfg.drop_prob > 0.0
+    if cfg.telemetry and not ring:
+        # make_config gates this (TELEMETRY requires the ring exchange);
+        # direct constructors must not silently get an empty timeline.
+        raise ValueError("cfg.telemetry requires the ring exchange")
 
     rng_build = _ring_rng_builder(cfg, use_drop) if ring else None
 
@@ -578,6 +593,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
              k_ack1, k_ack2) = jax.random.split(key, 8)
 
         drop_active = (t > drop_lo) & (t <= drop_hi)
+        # Per-tick coin-drop counts (TELEMETRY scalars only — every
+        # append below is guarded, so the off program gains nothing).
+        telem_dropped = []
         if use_drop:
             ctrl_u = (rng.ctrl_u.reshape(2, n) if ring
                       else jax.random.uniform(k_ctrl, (2, n)))
@@ -672,6 +690,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         seeds = state.joinreq_infl & recv_mask[intro]
         joinreq_infl = state.joinreq_infl & ~recv_mask[intro]
         rep_ok = seeds & ctrl_kept[1]
+        if cfg.telemetry and use_drop:
+            telem_dropped.append((seeds & ~ctrl_kept[1]).sum(dtype=I32))
         if track_budget:
             # A dropped JOINREP permanently strands the joiner (the
             # request was consumed; the reference never re-replies).
@@ -689,6 +709,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         in_group = in_group.at[intro].set(in_group[intro] | boot)
 
         joiner_req = start_now & (idx != intro) & ctrl_kept[0]
+        if cfg.telemetry and use_drop:
+            telem_dropped.append(
+                (start_now & (idx != intro) & ~ctrl_kept[0]).sum(dtype=I32))
         if track_budget:
             # A dropped JOINREQ is never retried (nodeStart runs once):
             # the node stays started but never enters the group.
@@ -734,65 +757,75 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # docstring).  vec[id] = the hb the target acked at t-1
                 # (self_hb at start of t-1, +1 — the mid-increment value
                 # the scatter path's own_hb carries), 0 if it wasn't act.
-                p_cnt = cfg.probes
-                ids2 = state.probe_ids2
-                id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-                vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
-                ids1 = state.probe_ids1
-                v1 = ids1 > 0
-                tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
-                # 'packed' (default): ack value + will-flush + act +
-                # counter bits ride ONE per-target gather per tick
-                # (_pack_probe_table) — the [N, 2P] index tensor is the
-                # t-2 ack indices and the t-1 counter indices
-                # concatenated.  n >= 4 guards the 30-bit hb headroom
-                # (see _pack_probe_table); PROBE_IO none draws no
-                # counter bits in either arm.
-                packed = cfg.probe_gather == "packed" and n >= 4
-                if cfg.probe_io_lag and packed:
-                    # approx_lag: the [N, P, 2] stacked gather collapses
-                    # to one packed-u32 [N, P] gather (t-1 snapshots of
-                    # the filter bits under the lagged heartbeat).
-                    g2 = _pack_probe_table(vec, state.wf_prev,
-                                           state.act_prev)[id2]
-                    hb_ack = _gathered_hb(g2)
-                    lag_bits = g2
-                elif cfg.probe_io_lag:
-                    # split arm (the pre-round-6 lowering): counter bits
-                    # ride the ack-value gather as a 2-wide last axis.
-                    tbl2 = jnp.stack(
-                        [vec, _pack_probe_bits(state.wf_prev,
-                                               state.act_prev)], axis=1)
-                    g2 = tbl2[id2]                  # [N, P, 2] one gather
-                    hb_ack = g2[..., 0]
-                    lag_bits = g2[..., 1]
-                elif packed and not cfg.probe_io_none:
-                    will_flush = _will_flush(recv_mask, fail_mask, t,
-                                             fail_time)
-                    tbl = _pack_probe_table(vec, will_flush, act)
-                    gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
-                    hb_ack = _gathered_hb(gcat[:, :p_cnt])
-                    probe_bits1 = gcat[:, p_cnt:]
-                else:
-                    hb_ack = vec[id2]                      # [N, P] gather
-                valid2 = (ids2 > 0) & (hb_ack > 0)
-                # Probe-leg drops applied at issue time (probe block below,
-                # one coin shared by both redundant copies, as in scatter
-                # mode); only the ack leg's coin applies here.
-                if use_drop:
-                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                    valid2 &= ~((rng.ack_u.reshape(ids2.shape) < p_drop)
-                                & da_ack)
-                cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
-                ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
-                cand_full = jnp.concatenate(
-                    [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
-                # ptr2 only takes multiples of gcd(P, S): static-roll
-                # switch instead of a full-plane dynamic lane roll.
-                cand_full = ptr_switch(
-                    ptr2, p_cnt, s,
-                    lambda o, c: jnp.roll(c, o, axis=1), cand_full)
-                ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
+                with jax.named_scope(PHASE_ACK):
+                    p_cnt = cfg.probes
+                    ids2 = state.probe_ids2
+                    id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+                    vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
+                    ids1 = state.probe_ids1
+                    v1 = ids1 > 0
+                    tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+                    # 'packed' (default): ack value + will-flush + act +
+                    # counter bits ride ONE per-target gather per tick
+                    # (_pack_probe_table) — the [N, 2P] index tensor is
+                    # the t-2 ack indices and the t-1 counter indices
+                    # concatenated.  n >= 4 guards the 30-bit hb headroom
+                    # (see _pack_probe_table); PROBE_IO none draws no
+                    # counter bits in either arm.
+                    packed = cfg.probe_gather == "packed" and n >= 4
+                    if cfg.probe_io_lag and packed:
+                        # approx_lag: the [N, P, 2] stacked gather
+                        # collapses to one packed-u32 [N, P] gather (t-1
+                        # snapshots of the filter bits under the lagged
+                        # heartbeat).
+                        g2 = _pack_probe_table(vec, state.wf_prev,
+                                               state.act_prev)[id2]
+                        hb_ack = _gathered_hb(g2)
+                        lag_bits = g2
+                    elif cfg.probe_io_lag:
+                        # split arm (the pre-round-6 lowering): counter
+                        # bits ride the ack-value gather as a 2-wide
+                        # last axis.
+                        tbl2 = jnp.stack(
+                            [vec, _pack_probe_bits(state.wf_prev,
+                                                   state.act_prev)],
+                            axis=1)
+                        g2 = tbl2[id2]              # [N, P, 2] one gather
+                        hb_ack = g2[..., 0]
+                        lag_bits = g2[..., 1]
+                    elif packed and not cfg.probe_io_none:
+                        will_flush = _will_flush(recv_mask, fail_mask, t,
+                                                 fail_time)
+                        tbl = _pack_probe_table(vec, will_flush, act)
+                        gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
+                        hb_ack = _gathered_hb(gcat[:, :p_cnt])
+                        probe_bits1 = gcat[:, p_cnt:]
+                    else:
+                        hb_ack = vec[id2]                  # [N, P] gather
+                    valid2 = (ids2 > 0) & (hb_ack > 0)
+                    # Probe-leg drops applied at issue time (probe block
+                    # below, one coin shared by both redundant copies, as
+                    # in scatter mode); only the ack leg's coin applies
+                    # here.
+                    if use_drop:
+                        da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                        ack_coin = ((rng.ack_u.reshape(ids2.shape)
+                                     < p_drop) & da_ack)
+                        if cfg.telemetry:
+                            telem_dropped.append(
+                                (valid2 & ack_coin).sum(dtype=I32))
+                        valid2 &= ~ack_coin
+                    cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+                    ptr2 = jax.lax.rem(
+                        jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+                    cand_full = jnp.concatenate(
+                        [cand, jnp.zeros((n, s - p_cnt), U32)], axis=1)
+                    # ptr2 only takes multiples of gcd(P, S): static-roll
+                    # switch instead of a full-plane dynamic lane roll.
+                    cand_full = ptr_switch(
+                        ptr2, p_cnt, s,
+                        lambda o, c: jnp.roll(c, o, axis=1), cand_full)
+                    ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
             recv_fn = (
                 (lambda *a: receive_fused(
                     n, s, cfg.tfail, cfg.tremove, STRIDE,
@@ -877,8 +910,12 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 payloads = []
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
-                    m = m & ~((rng.gossip_u[j].reshape(n, s) < p_drop)
-                              & drop_active)
+                    gossip_coin = ((rng.gossip_u[j].reshape(n, s)
+                                    < p_drop) & drop_active)
+                    if cfg.telemetry:
+                        telem_dropped.append(
+                            (m & gossip_coin).sum(dtype=I32))
+                    m = m & ~gossip_coin
                     payloads.append(jnp.where(m, view, U32(0)))
                     cnt = m.sum(1, dtype=I32)
                     sent_gossip = sent_gossip + cnt
@@ -895,8 +932,12 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
                     if use_drop:
-                        m = m & ~((rng.gossip_u[j].reshape(n, s) < p_drop)
-                                  & drop_active)
+                        gossip_coin = ((rng.gossip_u[j].reshape(n, s)
+                                        < p_drop) & drop_active)
+                        if cfg.telemetry:
+                            telem_dropped.append(
+                                (m & gossip_coin).sum(dtype=I32))
+                        m = m & ~gossip_coin
                     if track_budget:
                         m, used = _budget_take(m, used)
                     r = shifts[j]
@@ -976,6 +1017,9 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                        if ring else
                        jax.random.bernoulli(k_drop_s, p_drop,
                                             (seed_idx.shape[0], s)))
+            if cfg.telemetry:
+                telem_dropped.append(
+                    (burst_valid & dropped & drop_active).sum(dtype=I32))
             burst_valid = burst_valid & ~(dropped & drop_active)
         if track_budget:
             # One wire message per burst entry, after the gossip shifts
@@ -1005,20 +1049,25 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # static roll + static slice (a contiguous copy when the
             # band doesn't wrap) instead of rolling the whole [N, S]
             # plane dynamically to read P columns.
-            window = ptr_switch(
-                ptr, p_cnt, s,
-                lambda o, v: jnp.roll(v, -o, axis=1)[:, :p_cnt], view)
-            w_pres = window > 0
-            w_id = ((window - U32(1)) % U32(n)).astype(I32)
-            p_valid = w_pres & (w_id != idx[:, None]) & act[:, None]
-            if use_drop:
-                # Probe-leg drop at issue time (drop_active is the *current*
-                # window state, matching the scatter mode's timing); the
-                # dropped probe is never recorded, so counters and the ack
-                # pipeline both see only surviving probes.
-                p_valid = p_valid & ~(
-                    (rng.probe_u.reshape(p_valid.shape) < p_drop)
-                    & drop_active)
+            with jax.named_scope(PHASE_PROBE):
+                window = ptr_switch(
+                    ptr, p_cnt, s,
+                    lambda o, v: jnp.roll(v, -o, axis=1)[:, :p_cnt], view)
+                w_pres = window > 0
+                w_id = ((window - U32(1)) % U32(n)).astype(I32)
+                p_valid = w_pres & (w_id != idx[:, None]) & act[:, None]
+                if use_drop:
+                    # Probe-leg drop at issue time (drop_active is the
+                    # *current* window state, matching the scatter mode's
+                    # timing); the dropped probe is never recorded, so
+                    # counters and the ack pipeline both see only
+                    # surviving probes.
+                    probe_coin = ((rng.probe_u.reshape(p_valid.shape)
+                                   < p_drop) & drop_active)
+                    if cfg.telemetry:
+                        telem_dropped.append(
+                            (p_valid & probe_coin).sum(dtype=I32))
+                    p_valid = p_valid & ~probe_coin
             if track_budget:
                 # Probes queue after the gossip shifts; each costs p_red
                 # wire messages.  A budget-dropped probe is never
@@ -1187,6 +1236,33 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                               self_hb, mail, amail, pmail, joinreq_infl,
                               joinrep_infl, pending_recv, agg,
                               probe_ids1, probe_ids2, act_prev, wf_prev)
+        if cfg.telemetry:
+            # Flight-recorder scalars (observability/timeline.py): pure
+            # reductions over tensors computed above — no RNG, no state,
+            # so the trajectory is bit-identical with telemetry off
+            # (tests/test_timeline.py) and the off program never pays
+            # for this block (tests/test_hlo_census.py).
+            with jax.named_scope(PHASE_TELEMETRY):
+                zero = jnp.zeros((), I32)
+                dropped_tick = sum(telem_dropped, zero)
+                # Per-tick TRUE detections as the agg delta (identical on
+                # the FastAgg and AggStats paths; 0 in EVENT_MODE full
+                # runs, where no on-device detection state exists).
+                det_tick = (agg.det_count.sum(dtype=I32)
+                            - state.agg.det_count.sum(dtype=I32)
+                            if not cfg.collect_events else zero)
+                telem = TickTelemetry(
+                    live=act.sum(dtype=I32),
+                    suspected=numfailed.sum(dtype=I32),
+                    joins=(join_ids != EMPTY).sum(dtype=I32),
+                    removals=(rm_ids != EMPTY).sum(dtype=I32),
+                    detections=det_tick,
+                    msgs_sent=sent_tick.sum(dtype=I32),
+                    msgs_recv=recv_tick.sum(dtype=I32),
+                    dropped=dropped_tick,
+                    probe_acks=ack_recv_cnt.sum(dtype=I32),
+                    gossip_rows=sent_gossip.sum(dtype=I32))
+            return new_state, (out, telem)
         return new_state, out
 
     return step
@@ -1401,7 +1477,8 @@ def make_config(params: Params, collect_events: bool = True,
         probe_gather=(params.PROBE_GATHER
                       if exchange == "ring" and params.PROBES > 0
                       and n >= 4 else
-                      "split" if n < 4 else "packed"))
+                      "split" if n < 4 else "packed"),
+        telemetry=params.TELEMETRY == "scalars")
 
 
 _RUNNER_CACHE: dict = {}
@@ -1427,6 +1504,13 @@ def _get_runner(cfg: HashConfig, warm: bool):
                                     fail_time, drop_lo, drop_hi))
 
             final, ys = jax.lax.scan(body, state0, (ticks, keys))
+            telem = None
+            if cfg.telemetry:
+                # The telemetry series rides beside the event outputs;
+                # the lag epilogue below touches run TOTALS only (the
+                # timeline keeps the in-scan per-tick counters —
+                # observability/timeline.py field notes).
+                ys, telem = ys
             if cfg.probe_io_lag and cfg.probes > 0:
                 # Lag tail, ON-DEVICE inside the same jit (one [N, P]
                 # gather per RUN — amortized to nothing; a host epilogue
@@ -1448,7 +1532,7 @@ def _get_runner(cfg: HashConfig, warm: bool):
                         sent_total=final.agg.sent_total + corr))
                     ys = ys._replace(sent=ys.sent.at[-1].add(
                         corr.sum(dtype=I32)))
-            return final, ys
+            return final, ((ys, telem) if cfg.telemetry else ys)
 
         _RUNNER_CACHE[cache_key] = jax.jit(run)
     return _RUNNER_CACHE[cache_key]
@@ -1519,8 +1603,15 @@ def plan_fail_ids(plan: FailurePlan) -> tuple:
 
 
 def run_scan(params: Params, plan: FailurePlan, seed: int,
-             collect_events: bool = True, total_time: Optional[int] = None):
-    """Run the full simulation; returns (final_state, events)."""
+             collect_events: bool = True, total_time: Optional[int] = None,
+             telemetry=None):
+    """Run the full simulation; returns (final_state, events).
+
+    ``telemetry`` (a TimelineRecorder, observability/timeline.py) receives
+    the per-tick scalar series when ``TELEMETRY: scalars`` is on — per
+    segment boundary on the chunked path, once at the end of a monolithic
+    scan.  With telemetry on and no recorder the series is computed and
+    dropped (the bench's overhead leg times exactly this)."""
     cfg = make_config(params, collect_events, fail_ids=plan_fail_ids(plan))
     total = total_time if total_time is not None else params.TOTAL_TIME
     # Same effective-run-length packing guard as tpu_sparse.run_scan.
@@ -1565,7 +1656,10 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
             collect_events=collect_events,
             compact_fn=compact_sparse if collect_events else None,
             event_type=None if collect_events else SparseTickEvents,
-            finalize=finalize)
+            finalize=finalize,
+            telemetry_sink=(
+                (telemetry.flush if telemetry is not None
+                 else lambda telem, t0: None) if cfg.telemetry else None))
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
@@ -1574,7 +1668,12 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     final_state, events = run(
         keys, ticks, start_ticks, fail_mask, fail_time, drop_lo, drop_hi,
         make_run_key(params, seed ^ 0x5EED))
-    return final_state, jax.tree.map(np.asarray, events)
+    events = jax.tree.map(np.asarray, events)
+    if cfg.telemetry:
+        events, telem = events
+        if telemetry is not None:
+            telemetry.flush(telem, 0)
+    return final_state, events
 
 
 @register("tpu_hash")

@@ -110,25 +110,30 @@ def _folded_receive(n, tfail, tremove, rep, rowsum, self_mask, node,
 
     Returns (view, view_ts, mail_cleared, join_mask, rm_ids, numfailed,
     size, cur_id, present, difft)."""
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_RECEIVE)
     from distributed_membership_tpu.ops.fused_folded import (
         _folded_receive_body, receive_folded_fused)
 
-    if fused:
-        (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
-            receive_folded_fused(n, s, tfail, tremove, stride, interpret,
-                                 t, row0, view, view_ts, mail, cand_sf,
-                                 rcol, rep(act), rep(self_val))
-    else:
-        (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
-            _folded_receive_body(n, tfail, tremove, self_mask, node,
-                                 t, view, view_ts, mail, cand_sf,
-                                 rcol, rep(act), rep(self_val))
-    numfailed = rowsum(stale.astype(I32))
-    present = new_view > 0
-    cur_id = jnp.where(present,
-                       ((new_view - U32(1)) % U32(n)).astype(I32), EMPTY)
-    size = rowsum(present.astype(I32))
-    difft = t - new_ts
+    with jax.named_scope(PHASE_RECEIVE):
+        if fused:
+            (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
+                receive_folded_fused(n, s, tfail, tremove, stride,
+                                     interpret, t, row0, view, view_ts,
+                                     mail, cand_sf, rcol, rep(act),
+                                     rep(self_val))
+        else:
+            (new_view, new_ts, mail, join_mask, rm_ids, stale) = \
+                _folded_receive_body(n, tfail, tremove, self_mask, node,
+                                     t, view, view_ts, mail, cand_sf,
+                                     rcol, rep(act), rep(self_val))
+        numfailed = rowsum(stale.astype(I32))
+        present = new_view > 0
+        cur_id = jnp.where(present,
+                           ((new_view - U32(1)) % U32(n)).astype(I32),
+                           EMPTY)
+        size = rowsum(present.astype(I32))
+        difft = t - new_ts
     return (new_view, new_ts, mail, join_mask, rm_ids, numfailed, size,
             cur_id, present, difft)
 
@@ -146,7 +151,8 @@ def _sumP(x, rows, fp, p_cnt):
 
 def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
                          recv_mask, ack_u, p_drop, use_drop,
-                         drop_lo, drop_hi, tbl=None, ids1=None):
+                         drop_lo, drop_hi, tbl=None, ids1=None,
+                         count_dropped=False):
     """Ack candidates for probes issued at t-2 (the gather pipeline of
     tpu_hash.make_step ring), on P-folded probe state.  ``vec`` is the
     lagged heartbeat vector ([N]; the sharded caller passes its
@@ -155,37 +161,50 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
     probe table, tpu_hash._pack_probe_table — the sharded caller passes
     its single all_gather) and ``ids1`` are given, the ack heartbeat AND
     the t-1 counter-filter bits ride ONE concatenated gather; returns
-    (cand_sf [rows/F, 128], ack_recv_cnt [rows], bits1) with ``bits1``
-    the packed filter bits gathered at the t-1 targets (None on the
-    split arm)."""
+    (cand_sf [rows/F, 128], ack_recv_cnt [rows], bits1, ack_dropped)
+    with ``bits1`` the packed filter bits gathered at the t-1 targets
+    (None on the split arm) and ``ack_dropped`` the count of candidates
+    the ack-leg coin killed (TELEMETRY scalars; None unless
+    ``count_dropped``)."""
     from distributed_membership_tpu.backends.tpu_hash import (
         _gathered_hb, ptr_switch)
+    from distributed_membership_tpu.observability.timeline import PHASE_ACK
 
-    id2 = jnp.clip(ids2.astype(I32) - 1, 0)
-    bits1 = None
-    if tbl is not None and ids1 is not None:
-        tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
-        gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
-        hb_ack = _gathered_hb(gcat[:, :id2.shape[1]])
-        bits1 = gcat[:, id2.shape[1]:]
-    else:
-        hb_ack = vec[id2]
-    valid2 = (ids2 > 0) & (hb_ack > 0)
-    if use_drop:
-        da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-        valid2 &= ~((ack_u.reshape(ids2.shape) < p_drop) & da_ack)
-    cand = jnp.where(
-        valid2, hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
-    ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
-    cand_ext = jnp.concatenate([cand.reshape(-1), jnp.zeros((1,), U32)])
-    # Pointer takes only multiples of gcd(P, S): switch over static
-    # roll_slots calls (every roll inside goes static — tpu_hash.ptr_switch).
-    cand_sf = ptr_switch(ptr2, p_cnt, s,
-                         lambda o, c: roll_slots(c, o, s),
-                         cand_ext[cand_idx])
-    ack_recv_cnt = _sumP(valid2 & _repP(recv_mask, rows, fp, p_cnt),
-                         rows, fp, p_cnt).astype(I32)
-    return cand_sf, ack_recv_cnt, bits1
+    with jax.named_scope(PHASE_ACK):
+        id2 = jnp.clip(ids2.astype(I32) - 1, 0)
+        bits1 = None
+        if tbl is not None and ids1 is not None:
+            tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)
+            gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
+            hb_ack = _gathered_hb(gcat[:, :id2.shape[1]])
+            bits1 = gcat[:, id2.shape[1]:]
+        else:
+            hb_ack = vec[id2]
+        valid2 = (ids2 > 0) & (hb_ack > 0)
+        ack_dropped = None
+        if use_drop:
+            da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+            ack_coin = (ack_u.reshape(ids2.shape) < p_drop) & da_ack
+            if count_dropped:
+                ack_dropped = (valid2 & ack_coin).sum(dtype=I32)
+            valid2 &= ~ack_coin
+        elif count_dropped:
+            ack_dropped = jnp.zeros((), I32)
+        cand = jnp.where(
+            valid2,
+            hb_ack.astype(U32) * U32(n) + id2.astype(U32) + U32(1), 0)
+        ptr2 = jax.lax.rem(jax.lax.rem((t - 2) * p_cnt, s) + s, s)
+        cand_ext = jnp.concatenate([cand.reshape(-1),
+                                    jnp.zeros((1,), U32)])
+        # Pointer takes only multiples of gcd(P, S): switch over static
+        # roll_slots calls (every roll inside goes static —
+        # tpu_hash.ptr_switch).
+        cand_sf = ptr_switch(ptr2, p_cnt, s,
+                             lambda o, c: roll_slots(c, o, s),
+                             cand_ext[cand_idx])
+        ack_recv_cnt = _sumP(valid2 & _repP(recv_mask, rows, fp, p_cnt),
+                             rows, fp, p_cnt).astype(I32)
+    return cand_sf, ack_recv_cnt, bits1, ack_dropped
 
 
 def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, thin_u):
@@ -206,24 +225,36 @@ def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, thin_u):
 
 
 def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
-                       node_p, probe_u, p_drop, use_drop, drop_active):
+                       node_p, probe_u, p_drop, use_drop, drop_active,
+                       count_dropped=False):
     """Issue this tick's probes from the cyclic window (P-folded).
     ``probe_u`` is the planned issue-time drop uniform (flat; None when
-    drops are off).  Returns (ids_new [rows/FP, 128] u32, p_valid bool)."""
+    drops are off).  Returns (ids_new [rows/FP, 128] u32, p_valid bool,
+    probe_dropped) — the last the issue-leg coin-kill count (TELEMETRY
+    scalars; None unless ``count_dropped``)."""
     from distributed_membership_tpu.backends.tpu_hash import ptr_switch
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_PROBE)
 
-    ptr = jax.lax.rem(t * p_cnt, s)
-    rolled_w = ptr_switch((s - ptr) % s, p_cnt, s,
-                          lambda o, v: roll_slots(v, o, s), view)
-    window = rolled_w.reshape(-1)[window_idx]
-    w_pres = window > 0
-    w_id = ((window - U32(1)) % U32(n)).astype(I32)
-    p_valid = w_pres & (w_id != node_p) & _repP(act, rows, fp, p_cnt)
-    if use_drop:
-        p_valid = p_valid & ~(
-            (probe_u.reshape(p_valid.shape) < p_drop) & drop_active)
-    ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
-    return ids_new, p_valid
+    with jax.named_scope(PHASE_PROBE):
+        ptr = jax.lax.rem(t * p_cnt, s)
+        rolled_w = ptr_switch((s - ptr) % s, p_cnt, s,
+                              lambda o, v: roll_slots(v, o, s), view)
+        window = rolled_w.reshape(-1)[window_idx]
+        w_pres = window > 0
+        w_id = ((window - U32(1)) % U32(n)).astype(I32)
+        p_valid = w_pres & (w_id != node_p) & _repP(act, rows, fp, p_cnt)
+        probe_dropped = None
+        if use_drop:
+            probe_coin = ((probe_u.reshape(p_valid.shape) < p_drop)
+                          & drop_active)
+            if count_dropped:
+                probe_dropped = (p_valid & probe_coin).sum(dtype=I32)
+            p_valid = p_valid & ~probe_coin
+        elif count_dropped:
+            probe_dropped = jnp.zeros((), I32)
+        ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+    return ids_new, p_valid, probe_dropped
 
 
 def make_folded_step(cfg):
@@ -295,6 +326,7 @@ def make_folded_step(cfg):
 
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = rep(recv_mask)
+        telem_dropped = []      # TELEMETRY scalars only (guarded below)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -322,10 +354,14 @@ def make_folded_step(cfg):
                                          fail_time)
                 tbl = _pack_probe_table(vec, will_flush, act)
                 ids1_for_tbl = state.probe_ids1
-            cand_sf, ack_recv_cnt, bits1 = _fold_ack_candidates(
-                n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2, vec,
-                recv_mask, rng.ack_u if use_drop else None, p_drop,
-                use_drop, drop_lo, drop_hi, tbl=tbl, ids1=ids1_for_tbl)
+            cand_sf, ack_recv_cnt, bits1, ack_dropped = \
+                _fold_ack_candidates(
+                    n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2,
+                    vec, recv_mask, rng.ack_u if use_drop else None,
+                    p_drop, use_drop, drop_lo, drop_hi, tbl=tbl,
+                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry)
+            if cfg.telemetry and ack_dropped is not None:
+                telem_dropped.append(ack_dropped)
 
         # ---- receive: admit + ack + self + sweep (shared folded core) --
         (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
@@ -363,28 +399,35 @@ def make_folded_step(cfg):
             """One folded circulant delivery; ``r`` traced or Python int
             (the SHIFT_SET switch branches — mirrors
             tpu_hash.deliver_shift's dual contract)."""
-            static = isinstance(r, int)
-            s1 = ((r % s) * cstride % s if static
-                  else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
-            rolled = roll_nodes(payload, r, f, s)
-            r1 = roll_slots(rolled, s1, s)
-            if single_col_roll:
-                delivered = r1
-            else:
-                s2 = (((r - n) % s) * cstride % s if static
-                      else jax.lax.rem(
-                          jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
-                          * cstride, s))
-                r2 = roll_slots(rolled, s2, s)
-                delivered = jnp.where(rep((idx >= r)), r1, r2)
-            return delivered, jnp.roll(cnt, r)
+            from distributed_membership_tpu.observability.timeline import (
+                PHASE_GOSSIP)
+            with jax.named_scope(PHASE_GOSSIP):
+                static = isinstance(r, int)
+                s1 = ((r % s) * cstride % s if static
+                      else jax.lax.rem(jax.lax.rem(r, s) * cstride, s))
+                rolled = roll_nodes(payload, r, f, s)
+                r1 = roll_slots(rolled, s1, s)
+                if single_col_roll:
+                    delivered = r1
+                else:
+                    s2 = (((r - n) % s) * cstride % s if static
+                          else jax.lax.rem(
+                              jax.lax.rem(jax.lax.rem(r - n, s) + s, s)
+                              * cstride, s))
+                    r2 = roll_slots(rolled, s2, s)
+                    delivered = jnp.where(rep((idx >= r)), r1, r2)
+                return delivered, jnp.roll(cnt, r)
 
         stacked = []      # (payload, r, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
-                m = m & ~((rng.gossip_u[jshift].reshape(nf, LANES)
-                           < p_drop) & drop_active)
+                gossip_coin = ((rng.gossip_u[jshift].reshape(nf, LANES)
+                                < p_drop) & drop_active)
+                if cfg.telemetry:
+                    telem_dropped.append(
+                        (m & gossip_coin).sum(dtype=I32))
+                m = m & ~gossip_coin
             r = shifts[jshift]
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
@@ -427,10 +470,12 @@ def make_folded_step(cfg):
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if p_cnt > 0:
-            ids_new, p_valid = _fold_probe_window(
+            ids_new, p_valid, probe_dropped = _fold_probe_window(
                 n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
                 rng.probe_u if use_drop else None, p_drop, use_drop,
-                drop_active)
+                drop_active, count_dropped=cfg.telemetry)
+            if cfg.telemetry and probe_dropped is not None:
+                telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             psum_row = lambda x: _sumP(x, n, fp, p_cnt)  # noqa: E731
@@ -504,6 +549,28 @@ def make_folded_step(cfg):
                               state.joinrep_infl, pending_recv, agg,
                               probe_ids1, probe_ids2, act_prev,
                               state.wf_prev)
+        if cfg.telemetry:
+            # Flight-recorder scalars (observability/timeline.py) — the
+            # folded twin of tpu_hash.make_step's emission, from the same
+            # quantities on folded planes (bit-equal by the fold
+            # contract; tests/test_timeline.py).
+            from distributed_membership_tpu.observability.timeline import (
+                PHASE_TELEMETRY, TickTelemetry)
+            with jax.named_scope(PHASE_TELEMETRY):
+                zero = jnp.zeros((), I32)
+                telem = TickTelemetry(
+                    live=act.sum(dtype=I32),
+                    suspected=numfailed.sum(dtype=I32),
+                    joins=out.join_ids,
+                    removals=out.rm_ids,
+                    detections=(agg.det_count.sum(dtype=I32)
+                                - state.agg.det_count.sum(dtype=I32)),
+                    msgs_sent=out.sent,
+                    msgs_recv=out.recv,
+                    dropped=sum(telem_dropped, zero),
+                    probe_acks=ack_recv_cnt.sum(dtype=I32),
+                    gossip_rows=sent_gossip.sum(dtype=I32))
+            return new_state, (out, telem)
         return new_state, out
 
     return step
@@ -595,6 +662,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
 
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = rep(recv_mask)
+        telem_dropped = []      # TELEMETRY scalars only (guarded below)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -631,11 +699,15 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 ids1_for_tbl = state.probe_ids1
             else:
                 vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
-            cand_sf, ack_recv_cnt, bits1 = _fold_ack_candidates(
-                n, s, p_cnt, fp, cand_idx, n_local, t, state.probe_ids2,
-                vec_g, recv_mask, rng.ack_u if use_drop else None,
-                cfg.drop_prob, use_drop, drop_lo, drop_hi, tbl=tbl,
-                ids1=ids1_for_tbl)
+            cand_sf, ack_recv_cnt, bits1, ack_dropped = \
+                _fold_ack_candidates(
+                    n, s, p_cnt, fp, cand_idx, n_local, t,
+                    state.probe_ids2, vec_g, recv_mask,
+                    rng.ack_u if use_drop else None, cfg.drop_prob,
+                    use_drop, drop_lo, drop_hi, tbl=tbl,
+                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry)
+            if cfg.telemetry and ack_dropped is not None:
+                telem_dropped.append(ack_dropped)
 
         # ---- receive: admit + ack + self + sweep (shared folded core) --
         (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
@@ -660,8 +732,12 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             if use_drop:
-                m = m & ~((rng.gossip_u[jshift].reshape(lf, LANES)
-                           < cfg.drop_prob) & drop_active)
+                gossip_coin = ((rng.gossip_u[jshift].reshape(lf, LANES)
+                                < cfg.drop_prob) & drop_active)
+                if cfg.telemetry:
+                    telem_dropped.append(
+                        (m & gossip_coin).sum(dtype=I32))
+                m = m & ~gossip_coin
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
             sent_gossip = sent_gossip + cnt
@@ -684,14 +760,17 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 # the ppermute wire hop above stays as is.
                 stacked.append((payload_r, c, s1, s2))
                 continue
-            payload_r = roll_nodes(payload_r, c, f, s)
-            r1 = roll_slots(payload_r, s1, s)
-            if single_col_roll:
-                result = r1
-            else:
-                r2 = roll_slots(payload_r, s2, s)
-                result = jnp.where(rep(l_idx >= c), r1, r2)
-            mail = jnp.maximum(mail, result)
+            from distributed_membership_tpu.observability.timeline import (
+                PHASE_GOSSIP)
+            with jax.named_scope(PHASE_GOSSIP):
+                payload_r = roll_nodes(payload_r, c, f, s)
+                r1 = roll_slots(payload_r, s1, s)
+                if single_col_roll:
+                    result = r1
+                else:
+                    r2 = roll_slots(payload_r, s2, s)
+                    result = jnp.where(rep(l_idx >= c), r1, r2)
+                mail = jnp.maximum(mail, result)
         if cfg.fused_gossip and stacked:
             from distributed_membership_tpu.ops.fused_folded import (
                 gossip_folded_stacked)
@@ -708,10 +787,13 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if p_cnt > 0:
-            ids_new, p_valid = _fold_probe_window(
+            ids_new, p_valid, probe_dropped = _fold_probe_window(
                 n, s, p_cnt, fp, window_idx, n_local, t, view, act,
                 local_node_p + row0, rng.probe_u if use_drop else None,
-                cfg.drop_prob, use_drop, drop_active)
+                cfg.drop_prob, use_drop, drop_active,
+                count_dropped=cfg.telemetry)
+            if cfg.telemetry and probe_dropped is not None:
+                telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             psum_row = lambda x: _sumP(x, n_local, fp, p_cnt)  # noqa: E731
@@ -801,6 +883,27 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             self_hb, mail, state.amail, state.pmail,
             state.joinreq_infl, state.joinrep_infl, pending_recv, agg,
             probe_ids1, probe_ids2, act_prev)
+        if cfg.telemetry:
+            # Sharded flight-recorder scalars: local reductions + one
+            # psum each (observability/timeline.py).
+            from distributed_membership_tpu.observability.timeline import (
+                PHASE_TELEMETRY, TickTelemetry)
+            with jax.named_scope(PHASE_TELEMETRY):
+                zero = jnp.zeros((), I32)
+                telem = TickTelemetry(
+                    live=lax.psum(act.sum(dtype=I32), AX),
+                    suspected=lax.psum(numfailed.sum(dtype=I32), AX),
+                    joins=out.join_ids,
+                    removals=out.rm_ids,
+                    detections=lax.psum(
+                        agg.det_count.sum(dtype=I32)
+                        - state.agg.det_count.sum(dtype=I32), AX),
+                    msgs_sent=out.sent,
+                    msgs_recv=out.recv,
+                    dropped=lax.psum(sum(telem_dropped, zero), AX),
+                    probe_acks=lax.psum(ack_recv_cnt.sum(dtype=I32), AX),
+                    gossip_rows=lax.psum(sent_gossip.sum(dtype=I32), AX))
+            return new_state, (out, telem)
         return new_state, out
 
     return step

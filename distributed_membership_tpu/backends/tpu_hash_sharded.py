@@ -76,6 +76,8 @@ from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     AggStats, FastAgg, init_agg, init_fast_agg, update_agg, update_fast_agg)
+from distributed_membership_tpu.observability.timeline import (
+    PHASE_ACK, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry, telemetry_spec)
 from distributed_membership_tpu.ops.fused_receive import (
     receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
@@ -213,6 +215,8 @@ def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
         raise ValueError(
             f"axis_sizes {axis_sizes} must match axes {axes} — pass one "
             "size per mesh axis (the per-axis decomposition needs both)")
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_COLLECTIVE)
     if len(axes) == 1:
         ax = axes[0]
 
@@ -224,7 +228,9 @@ def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
                         for src in range(n_shards)]
                 return lambda ops: tuple(
                     lax.ppermute(o, ax, perm) for o in ops)
-            return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+            with jax.named_scope(PHASE_COLLECTIVE):
+                return lax.switch(b, [mk(i) for i in range(n_shards)],
+                                  tensors)
         return block_send
 
     assert int(np.prod(axis_sizes)) == n_shards
@@ -281,7 +287,8 @@ def make_block_send(n_shards: int, axes: tuple, axis_sizes: tuple):
                         carry = d_new < eff
                 return ops
             return go
-        return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
+        with jax.named_scope(PHASE_COLLECTIVE):
+            return lax.switch(b, [mk(i) for i in range(n_shards)], tensors)
     return block_send
 
 
@@ -368,6 +375,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             use_drop=use_drop, cold_join=cold_join,
             batched=cfg.rng_mode != "scattered")
         drop_active = (t > drop_lo) & (t <= drop_hi)
+        telem_dropped = []      # LOCAL counts (psum'd at emission);
+        #                         TELEMETRY scalars only — guarded below.
 
         # ---- receive: admit + ack + self + sweep as one fused pass ----
         # (ops/fused_receive: receive_core, or its Pallas twin when
@@ -398,6 +407,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             seeds_g = joinreq_g & intro_recv
             joinreq_infl = state.joinreq_infl & ~intro_recv
             rep_ok_g = seeds_g & ctrl_kept_g[1]
+            if cfg.telemetry and use_drop:
+                # Local slice of the replicated control plane so the
+                # emission psum counts each dropped JOINREP once.
+                telem_dropped.append(lax.dynamic_slice(
+                    seeds_g & ~ctrl_kept_g[1], (row0,),
+                    (n_local,)).sum(dtype=I32))
             rep_ok_l = lax.dynamic_slice(rep_ok_g, (row0,), (n_local,))
             joinrep_infl = joinrep_infl | rep_ok_l
             n_seeds = seeds_g.sum(dtype=I32)
@@ -410,6 +425,10 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             in_group = in_group | (is_intro_row & boot)
             ctrl0_l = lax.dynamic_slice(ctrl_kept_g[0], (row0,), (n_local,))
             joiner_req = start_now & (lrows != INTRO) & ctrl0_l
+            if cfg.telemetry and use_drop:
+                telem_dropped.append(
+                    (start_now & (lrows != INTRO)
+                     & ~ctrl0_l).sum(dtype=I32))
             joinreq_infl = joinreq_infl | joiner_req
             sent_req = joiner_req.astype(I32)
             joiner_req_g = ((t == start_ticks_g) & (idx_g != INTRO)
@@ -453,34 +472,40 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             ids1 = state.probe_ids1
             v1 = ids1 > 0
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
-            if packed_gather and not cfg.probe_io_none:
-                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                           fail_time)
-                tbl_g = lax.all_gather(
-                    _pack_probe_table(vec_l, will_flush_l, act), AX,
-                    tiled=True)                          # ONE [N] wire
-                will_flush_g = _gathered_flush(tbl_g)
-                gcat = tbl_g[jnp.concatenate([id2, tgt1], axis=1)]
-                hb_ack = _gathered_hb(gcat[:, :cfg.probes])
-                probe_bits1 = gcat[:, cfg.probes:]
-            else:
-                vec_g = lax.all_gather(vec_l, AX, tiled=True)     # [N]
-                hb_ack = vec_g[id2]
-            valid2 = (ids2 > 0) & (hb_ack > 0)
-            if use_drop:
-                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                valid2 &= ~((rng.ack_u.reshape(ids2.shape)
-                             < cfg.drop_prob) & da_ack)
-            cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
-            ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
-            cand_full = jnp.concatenate(
-                [cand, jnp.zeros((n_local, s - cfg.probes), U32)], axis=1)
-            # Static-roll switch over the pointer's multiples-of-gcd set
-            # (see tpu_hash.ptr_switch).
-            cand_full = ptr_switch(
-                ptr2, cfg.probes, s,
-                lambda o, c: jnp.roll(c, o, axis=1), cand_full)
-            ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
+            with jax.named_scope(PHASE_ACK):
+                if packed_gather and not cfg.probe_io_none:
+                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
+                                               fail_time)
+                    tbl_g = lax.all_gather(
+                        _pack_probe_table(vec_l, will_flush_l, act), AX,
+                        tiled=True)                      # ONE [N] wire
+                    will_flush_g = _gathered_flush(tbl_g)
+                    gcat = tbl_g[jnp.concatenate([id2, tgt1], axis=1)]
+                    hb_ack = _gathered_hb(gcat[:, :cfg.probes])
+                    probe_bits1 = gcat[:, cfg.probes:]
+                else:
+                    vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
+                    hb_ack = vec_g[id2]
+                valid2 = (ids2 > 0) & (hb_ack > 0)
+                if use_drop:
+                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                    ack_coin = ((rng.ack_u.reshape(ids2.shape)
+                                 < cfg.drop_prob) & da_ack)
+                    if cfg.telemetry:
+                        telem_dropped.append(
+                            (valid2 & ack_coin).sum(dtype=I32))
+                    valid2 &= ~ack_coin
+                cand = jnp.where(valid2, pack(cfg, hb_ack, id2), 0)
+                ptr2 = lax.rem(lax.rem((t - 2) * cfg.probes, s) + s, s)
+                cand_full = jnp.concatenate(
+                    [cand, jnp.zeros((n_local, s - cfg.probes), U32)],
+                    axis=1)
+                # Static-roll switch over the pointer's multiples-of-gcd
+                # set (see tpu_hash.ptr_switch).
+                cand_full = ptr_switch(
+                    ptr2, cfg.probes, s,
+                    lambda o, c: jnp.roll(c, o, axis=1), cand_full)
+                ack_recv_cnt = (valid2 & rcol).sum(1, dtype=I32)
 
         recv_fn = (
             (lambda *a: receive_fused(
@@ -542,8 +567,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         for j in range(k_max):
             m = keep & (j < k_eff)[:, None]
             if use_drop:
-                m = m & ~((rng.gossip_u[j].reshape(n_local, s)
-                           < cfg.drop_prob) & drop_active)
+                gossip_coin = ((rng.gossip_u[j].reshape(n_local, s)
+                                < cfg.drop_prob) & drop_active)
+                if cfg.telemetry:
+                    telem_dropped.append(
+                        (m & gossip_coin).sum(dtype=I32))
+                m = m & ~gossip_coin
             payload = jnp.where(m, view, U32(0))
             cnt = m.sum(1, dtype=I32)
             sent_gossip = sent_gossip + cnt
@@ -610,9 +639,16 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             seed_valid = seeds_g[seed_idx] & seed_burst_on
             burst_valid = seed_valid[:, None] & b_fresh[None, :]
             if use_drop:
-                burst_valid = burst_valid & ~(
-                    (rng.burst_u.reshape(cap, s) < cfg.drop_prob)
-                    & drop_active)
+                burst_coin = ((rng.burst_u.reshape(cap, s)
+                               < cfg.drop_prob) & drop_active)
+                if cfg.telemetry:
+                    # burst_valid/coin are REPLICATED (the burst stream
+                    # is shared): attribute the count to the introducer's
+                    # shard so the emission psum counts it once.
+                    telem_dropped.append(jnp.where(
+                        intro_here,
+                        (burst_valid & burst_coin).sum(dtype=I32), 0))
+                burst_valid = burst_valid & ~burst_coin
             owned = (seed_idx >= row0) & (seed_idx < row0 + n_local)
             lrow = jnp.clip(seed_idx - row0, 0, n_local - 1)
             b_addr = jnp.where(
@@ -637,19 +673,24 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
         if cfg.probes > 0:
-            ptr = lax.rem(t * cfg.probes, s)
-            window = ptr_switch(
-                ptr, cfg.probes, s,
-                lambda o, v: jnp.roll(v, -o, axis=1)[:, :cfg.probes],
-                view)
-            w_pres = window > 0
-            w_id = ((window - U32(1)) % U32(n)).astype(I32)
-            p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
-            if use_drop:
-                p_valid = p_valid & ~(
-                    (rng.probe_u.reshape(p_valid.shape) < cfg.drop_prob)
-                    & drop_active)
-            ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+            with jax.named_scope(PHASE_PROBE):
+                ptr = lax.rem(t * cfg.probes, s)
+                window = ptr_switch(
+                    ptr, cfg.probes, s,
+                    lambda o, v: jnp.roll(v, -o, axis=1)[:, :cfg.probes],
+                    view)
+                w_pres = window > 0
+                w_id = ((window - U32(1)) % U32(n)).astype(I32)
+                p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
+                if use_drop:
+                    probe_coin = ((rng.probe_u.reshape(p_valid.shape)
+                                   < cfg.drop_prob) & drop_active)
+                    if cfg.telemetry:
+                        telem_dropped.append(
+                            (p_valid & probe_coin).sum(dtype=I32))
+                    p_valid = p_valid & ~probe_coin
+                ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1),
+                                    U32(0))
             probe_ids2, probe_ids1 = probe_ids1, ids_new
             act_prev = act
             sent_probes = p_valid.sum(1, dtype=I32) * p_red
@@ -746,6 +787,31 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             mail, state.amail, state.pmail, joinreq_infl,
             joinrep_infl, pending_recv, agg,
             probe_ids1, probe_ids2, act_prev)
+        if cfg.telemetry:
+            # Sharded flight-recorder scalars: local reductions + one
+            # psum each (observability/timeline.py).  The detections
+            # delta is over the per-shard agg partials (0 in collect
+            # mode, where agg passes through untouched).
+            with jax.named_scope(PHASE_TELEMETRY):
+                zero = jnp.zeros((), I32)
+                telem = TickTelemetry(
+                    live=lax.psum(act.sum(dtype=I32), AX),
+                    suspected=lax.psum(numfailed.sum(dtype=I32), AX),
+                    joins=lax.psum(
+                        (join_ids != EMPTY).sum(dtype=I32), AX),
+                    removals=lax.psum(
+                        (rm_ids != EMPTY).sum(dtype=I32), AX),
+                    detections=lax.psum(
+                        agg.det_count.sum(dtype=I32)
+                        - state.agg.det_count.sum(dtype=I32), AX),
+                    msgs_sent=lax.psum(sent_tick.sum(dtype=I32), AX),
+                    msgs_recv=lax.psum(recv_tick.sum(dtype=I32), AX),
+                    dropped=lax.psum(sum(telem_dropped, zero), AX),
+                    probe_acks=lax.psum(
+                        ack_recv_cnt.sum(dtype=I32), AX),
+                    gossip_rows=lax.psum(
+                        sent_gossip.sum(dtype=I32), AX))
+            return new_state, (out, telem)
         return new_state, out
 
     return step
@@ -1175,6 +1241,10 @@ def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
             sent=P(None, axes), recv=P(None, axes))
     else:
         out_spec = SparseTickEvents(P(None), P(None), P(None), P(None))
+    if cfg.telemetry:
+        # The per-tick outputs become (events, TickTelemetry) — every
+        # telemetry field is a replicated scalar (psum'd in-step).
+        out_spec = (out_spec, telemetry_spec(P(None)))
     return step, init, state_spec, out_spec, AX
 
 
@@ -1284,7 +1354,7 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
 
 def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                      mesh: Mesh, collect_events: bool = True,
-                     total_time: Optional[int] = None):
+                     total_time: Optional[int] = None, telemetry=None):
     n = params.EN_GPSZ
     d = mesh.size
     if n % d != 0:
@@ -1397,7 +1467,10 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
             init_carry=lambda: init_run(warm_key),
             segment_fn=segment_fn, collect_events=collect_events,
             compact_fn=compact_sparse if collect_events else None,
-            event_type=None if collect_events else SparseTickEvents)
+            event_type=None if collect_events else SparseTickEvents,
+            telemetry_sink=(
+                (telemetry.flush if telemetry is not None
+                 else lambda telem, t0: None) if cfg.telemetry else None))
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
@@ -1406,7 +1479,12 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     final_state, events = run(keys, ticks, start_ticks, fail_mask,
                               fail_time, drop_lo, drop_hi,
                               make_run_key(params, seed ^ 0x5EED))
-    return final_state, jax.tree.map(np.asarray, events)
+    events = jax.tree.map(np.asarray, events)
+    if cfg.telemetry:
+        events, telem = events
+        if telemetry is not None:
+            telemetry.flush(telem, 0)
+    return final_state, events
 
 
 @register("tpu_hash_sharded")
@@ -1431,10 +1509,11 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
             mesh = make_mesh(d)
 
     def run_scan_bound(params, plan, seed, collect_events=True,
-                       total_time=None):
+                       total_time=None, telemetry=None):
         return run_scan_sharded(params, plan, seed, mesh,
                                 collect_events=collect_events,
-                                total_time=total_time)
+                                total_time=total_time,
+                                telemetry=telemetry)
 
     result = finish_run(params, plan, log, run_scan_bound, t0, seed)
     result.extra["mesh_size"] = mesh.size

@@ -53,6 +53,7 @@ late ack (strictly higher heartbeat) rescues it before TREMOVE.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random as _pyrandom
 import time as _time
 from typing import NamedTuple, Optional
@@ -584,8 +585,20 @@ def finish_run(params: Params, plan: FailurePlan, log: EventLog,
     the detection summary from the on-device aggregates (agg — the only
     mode that works at 1M nodes, VERDICT r1 item 3)."""
     aggregate = params.resolved_event_mode() == "agg"
+    kw = {}
+    recorder = None
+    if params.TELEMETRY == "scalars":
+        # Flight recorder (observability/timeline.py): only the ring
+        # backends get here (config.validate gates the knob), and their
+        # run_scan accepts the recorder.  Series land in
+        # extra['timeline']; TELEMETRY_DIR additionally streams
+        # timeline.jsonl per segment boundary.
+        from distributed_membership_tpu.observability.timeline import (
+            TimelineRecorder)
+        recorder = TimelineRecorder(params.TELEMETRY_DIR or None)
+        kw["telemetry"] = recorder
     final_state, events = run_scan_fn(params, plan, seed,
-                                      collect_events=not aggregate)
+                                      collect_events=not aggregate, **kw)
     failed = plan.failed_indices if plan.fail_time is not None else []
     if aggregate:
         if plan.fail_time is not None:
@@ -614,6 +627,17 @@ def finish_run(params: Params, plan: FailurePlan, log: EventLog,
         sent = np.asarray(events.sent).T
         recv = np.asarray(events.recv).T
         extra = {"final_state": final_state}
+    if recorder is not None:
+        extra["timeline"] = recorder.series()
+        extra["timeline_path"] = recorder.path
+        if params.TELEMETRY_DIR and aggregate:
+            # Make the flight-recorder dir self-contained for
+            # scripts/run_report.py: the detection verdicts next to the
+            # per-tick series they must reconcile with.
+            import json as _json
+            with open(os.path.join(params.TELEMETRY_DIR,
+                                   "summary.json"), "w") as fh:
+                _json.dump(extra["detection_summary"], fh, indent=1)
     return RunResult(
         params=params, log=log, sent=sent, recv=recv,
         failed_indices=failed, fail_time=plan.fail_time,

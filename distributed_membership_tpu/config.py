@@ -236,6 +236,26 @@ class Params:
     # double-buffers it against the next segment's device work), so the
     # CPU usually hides.  Resume reads either format transparently.
     CHECKPOINT_COMPRESS: int = 0
+    # Flight recorder, part 1 (observability/timeline.py): 'scalars'
+    # makes the jitted ring steps (tpu_hash natural + FOLDED,
+    # tpu_hash_sharded) emit a small tuple of per-tick scalar reductions
+    # — live/suspected counts, admissions, removals, true detections,
+    # msgs sent/recv/dropped, probe acks, gossip payload rows — stacked
+    # as [K]-shaped series per CHECKPOINT_EVERY segment and flushed
+    # host-side into TELEMETRY_DIR/timeline.jsonl at every segment
+    # boundary.  Trajectory-inert by construction (no RNG consumed, no
+    # state touched — bit-exactness pinned in tests/test_timeline.py)
+    # and structurally free when 'off' (the default program is op-count
+    # identical — tests/test_hlo_census.py).  Ring exchange only; the
+    # scatter/emul paths reject the knob loudly.
+    TELEMETRY: str = "off"
+    # Directory for the flight-recorder artifacts: timeline.jsonl
+    # (TELEMETRY: scalars) and runlog.jsonl (per-segment wall/sync/
+    # checkpoint-overlap events from the chunked driver — written for
+    # ANY chunked backend when this key is set, independent of
+    # TELEMETRY).  '' = keep telemetry in memory only (the series still
+    # lands in RunResult.extra['timeline']).
+    TELEMETRY_DIR: str = ""
     # 1 = resume from CHECKPOINT_DIR's latest valid checkpoint when one
     # exists (manifest validated against this config/seed — a mismatch
     # raises instead of silently computing a different run); when none
@@ -366,6 +386,23 @@ class Params:
                 raise ValueError(
                     "RNG_MODE hoisted requires the ring exchange (the "
                     "scatter lowering keeps its site-local draws)")
+        if self.TELEMETRY not in ("off", "scalars"):
+            raise ValueError(
+                f"TELEMETRY must be off|scalars, got {self.TELEMETRY!r}")
+        if self.TELEMETRY == "scalars":
+            # Loud-rejection policy (as PROBE_IO approx_lag / SHIFT_SET):
+            # only the ring steps emit the per-tick scalars — silently
+            # accepting the knob elsewhere would hand back an empty
+            # timeline while claiming flight-recorder coverage.
+            if self.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
+                raise ValueError(
+                    "TELEMETRY scalars is implemented by the ring "
+                    "backends only (tpu_hash, tpu_hash_sharded; got "
+                    f"BACKEND {self.BACKEND!r})")
+            if self.resolved_exchange() != "ring":
+                raise ValueError(
+                    "TELEMETRY scalars requires the ring exchange (the "
+                    "scatter lowering keeps the default program)")
         if self.PROBE_GATHER not in ("packed", "split"):
             raise ValueError(
                 f"PROBE_GATHER must be packed|split, got "

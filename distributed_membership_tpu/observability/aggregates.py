@@ -32,11 +32,26 @@ behavior and cost are untouched):
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _phase_scoped(fn):
+    """Wrap an update in the flight recorder's aggregation phase scope
+    (observability/timeline.PHASE_AGG) so profiler captures attribute
+    its cost separately from the exchange (zero-op: named_scope only
+    prefixes op metadata)."""
+    from distributed_membership_tpu.observability.timeline import PHASE_AGG
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kw):
+        with jax.named_scope(PHASE_AGG):
+            return fn(*args, **kw)
+    return wrapped
 
 I32 = jnp.int32
 LAT_BINS = 512          # ticks-after-failure resolution; last bin is overflow
@@ -81,6 +96,7 @@ def init_agg(n: int, rows: int | None = None) -> AggStats:
     )
 
 
+@_phase_scoped
 def update_agg(agg: AggStats, *, t: jax.Array,
                join_ids: jax.Array, rm_ids: jax.Array,
                view_ids: jax.Array, view_present: jax.Array,
@@ -191,6 +207,7 @@ def init_fast_agg(n_failed: int, rows: int) -> FastAgg:
     )
 
 
+@_phase_scoped
 def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
                     join_events: jax.Array, rm_ids: jax.Array,
                     view_ids: jax.Array, view_present: jax.Array,

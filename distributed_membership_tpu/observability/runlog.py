@@ -1,0 +1,116 @@
+"""Flight recorder, part 3: the structured run/ladder event log.
+
+One JSONL event stream replaces the ad-hoc text logs the harness grew
+(``artifacts/ladder_daemon*.log`` prints, ``rung_errors.log`` traceback
+dumps): every record is ``{"ts": <iso8601Z>, "kind": <event>, ...}``, so
+``scripts/run_report.py`` can render rung provenance, compile-vs-execute
+timing, and per-segment checkpoint overlap from one file without parsing
+free-form text.
+
+Producers:
+  * ``runtime/checkpoint.chunked_run`` — ``segments_start`` /
+    ``segment`` (per-boundary wall, device-sync and checkpoint-write-wait
+    seconds) / ``segments_done``, written to
+    ``<TELEMETRY_DIR>/runlog.jsonl``;
+  * ``scripts/profile_step.py`` — ``compile`` / ``execute`` timestamps
+    per timing point (``--runlog``);
+  * ``scripts/tpu_ladder.py`` — ``rung_start`` / ``rung_attempt`` /
+    ``rung_timeout`` / ``rung_retry`` / ``rung_land`` / ``rung_fail`` /
+    ``rung_error`` / ``pass_done`` into
+    ``artifacts/ladder_events.jsonl``.
+
+The log rotates by size (``path`` → ``path.1`` → … ``path.<keep>``) so a
+long-lived ladder daemon cannot grow it unboundedly, and every append is
+a single ``write`` of one line — a crash can tear at most the trailing
+record, which :func:`read_events` skips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+
+class RunLog:
+    """Append-only rotating JSONL event log."""
+
+    def __init__(self, path: str, max_bytes: int = 4 << 20, keep: int = 2):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.keep = max(keep, 1)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def _rotate_if_needed(self) -> None:
+        try:
+            if os.path.getsize(self.path) < self.max_bytes:
+                return
+        except OSError:
+            return
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+
+    def _tail_unterminated(self) -> bool:
+        """True when the file ends mid-line (a previous writer died
+        mid-append): the next record must start on a fresh line or it
+        would concatenate onto — and corrupt — the torn one."""
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(-1, os.SEEK_END)
+                return fh.read(1) != b"\n"
+        except (OSError, ValueError):
+            return False
+
+    def event(self, kind: str, **fields) -> dict:
+        """Append one event; returns the record (with its timestamp)."""
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "t_mono": round(time.monotonic(), 3),
+               "kind": kind}
+        rec.update(fields)
+        self._rotate_if_needed()
+        lead = "\n" if self._tail_unterminated() else ""
+        with open(self.path, "a") as fh:
+            fh.write(lead + json.dumps(rec, default=str) + "\n")
+        return rec
+
+
+def read_events(path: str, kinds=None,
+                include_rotated: bool = True) -> List[dict]:
+    """Parse a RunLog file (oldest first, rotated generations included);
+    skips torn/non-JSON lines.  ``kinds`` filters by event kind."""
+    paths = []
+    if include_rotated:
+        gen = 1
+        while os.path.exists(f"{path}.{gen}"):
+            paths.append(f"{path}.{gen}")
+            gen += 1
+        paths.reverse()
+    if os.path.exists(path):
+        paths.append(path)
+    out = []
+    for p in paths:
+        with open(p) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if kinds is None or rec.get("kind") in kinds:
+                    out.append(rec)
+    return out
+
+
+def maybe_runlog(directory: Optional[str],
+                 name: str = "runlog.jsonl") -> Optional[RunLog]:
+    """A RunLog under ``directory`` when one is configured, else None —
+    the one-liner chunked_run and the drivers gate their emission on."""
+    return RunLog(os.path.join(directory, name)) if directory else None

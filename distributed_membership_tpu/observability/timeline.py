@@ -1,0 +1,231 @@
+"""Flight recorder, part 1: in-scan per-tick telemetry (``TELEMETRY``).
+
+A served hardware window used to bank one wall-clock number per rung while
+protocol health (live/suspect counts, gossip freshness, detection progress)
+was only visible as run-total aggregates after the last tick.  This module
+is the per-tick counterpart: with ``TELEMETRY: scalars`` the jitted ring
+steps (tpu_hash natural + folded, tpu_hash_sharded natural + folded) emit a
+:class:`TickTelemetry` of scalar reductions every tick — O(1) extra
+reductions over tensors the step already computes, consuming no RNG and
+touching no state, so the trajectory is bit-identical to a telemetry-off
+run (pinned in tests/test_timeline.py).  The scalars stack into
+``[K]``-shaped per-segment series inside each ``CHECKPOINT_EVERY`` scan
+segment (O(K) device memory) and flush host-side at every segment boundary
+into ``timeline.jsonl`` — composing with kill/resume: a re-run segment
+re-flushes its record and the reader keeps the last write per tick range.
+
+With ``TELEMETRY: off`` (the default) none of this exists in the compiled
+program: every emission site is guarded by ``cfg.telemetry``, and
+tests/test_hlo_census.py pins the off program op-count identical to the
+default lowering at the [1M, 16] north-star geometry.
+
+Field semantics (all int32 scalars per tick):
+  * ``live``        — nodes active this tick (started, in-group, not failed);
+  * ``suspected``   — view entries in the TFAIL suspicion state this tick;
+  * ``joins``       — admissions into empty view slots this tick;
+  * ``removals``    — TREMOVE evictions this tick;
+  * ``detections``  — TRUE detections this tick (removals of a crashed id
+    after its crash; 0 in EVENT_MODE full runs — cumulate host-side, see
+    :func:`read_timeline`'s ``detections_cum``);
+  * ``msgs_sent`` / ``msgs_recv`` — wire messages sent / delivered into
+    the receive stream this tick (PROBE_IO approx_lag's final-tick
+    ack-send epilogue applies to run totals only, not this series);
+  * ``dropped``     — messages killed by drop coins this tick (budget
+    drops under ENFORCE_BUFFSIZE are not counted here);
+  * ``probe_acks``  — ack messages applied by the probe pipeline this tick;
+  * ``gossip_rows`` — view entries carried by gossip payloads this tick.
+
+Part 2 of the recorder is phase-scoped tracing: the protocol phases are
+wrapped in ``jax.named_scope`` (names below) across all four ring twins
+and the fused kernels, so a ``jax.profiler`` capture
+(``scripts/profile_step.py --trace-dir``) attributes per-phase device time
+without a dedicated bisect run.  Part 3 (the structured run/ladder event
+log) lives in observability/runlog.py.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+# The protocol-phase annotation names every ring twin emits
+# (jax.named_scope); the ``dm_`` prefix makes them greppable in captured
+# profiler artifacts (scripts/profile_step.py --trace-dir byte-scans the
+# xplane/trace files for exactly these strings).
+PHASE_RECEIVE = "dm_receive_sweep"      # admit + ack-merge + self + sweep
+PHASE_ACK = "dm_ack_apply"              # ack-candidate gather pipeline
+PHASE_GOSSIP = "dm_gossip_exchange"     # circulant shift delivery
+PHASE_COLLECTIVE = "dm_exchange_collective"  # sharded ppermute wire hop
+PHASE_PROBE = "dm_probe_issue"          # probe window issue + counters
+PHASE_AGG = "dm_aggregates"             # on-device event aggregation
+PHASE_TELEMETRY = "dm_telemetry"        # the scalar reductions themselves
+
+# The subset guaranteed present in ANY compiled ring step (single-chip or
+# sharded, probes on, natural or folded) — what the trace test asserts.
+PHASE_NAMES = (PHASE_RECEIVE, PHASE_ACK, PHASE_GOSSIP, PHASE_PROBE,
+               PHASE_AGG)
+
+
+class TickTelemetry(NamedTuple):
+    """One tick's scalar telemetry (module docstring for semantics).
+    Inside the scan each field is a [] int32; stacked by the scan they
+    become the per-segment [K] series the recorder flushes."""
+    live: object
+    suspected: object
+    joins: object
+    removals: object
+    detections: object
+    msgs_sent: object
+    msgs_recv: object
+    dropped: object
+    probe_acks: object
+    gossip_rows: object
+
+
+TELEMETRY_FIELDS = TickTelemetry._fields
+TIMELINE_NAME = "timeline.jsonl"
+
+
+def telemetry_spec(p):
+    """A TickTelemetry of identical (sharding/shape) specs — the sharded
+    backend's out_specs entry (every field is a replicated scalar)."""
+    return TickTelemetry(*(p for _ in TELEMETRY_FIELDS))
+
+
+class TimelineRecorder:
+    """Accumulates per-segment telemetry series and (optionally) appends
+    them to ``<dir>/timeline.jsonl`` as they arrive.
+
+    One JSONL record per flushed segment: ``{"t0": <first tick>,
+    "ticks": K, "<field>": [K ints], ...}``.  Appending is crash-safe by
+    construction (a torn trailing line is skipped by the reader) and
+    resume-safe by keying on ``t0``: a killed-and-resumed run re-flushes
+    the segments after its last durable checkpoint, and
+    :func:`read_timeline` keeps the LAST record per ``t0`` — so the file
+    converges to the uninterrupted run's content (tests/test_timeline.py).
+    """
+
+    def __init__(self, directory: Optional[str] = None):
+        self.path = None
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+            self.path = os.path.join(directory, TIMELINE_NAME)
+        self._chunks: list = []      # [(t0, {field: np.ndarray[K]})]
+
+    def flush(self, telem, t0: int) -> None:
+        """Bank one segment's [K]-shaped series starting at tick ``t0``."""
+        rec = {f: np.asarray(getattr(telem, f)).astype(np.int64).reshape(-1)
+               for f in TELEMETRY_FIELDS}
+        self._chunks.append((int(t0), rec))
+        if self.path:
+            line = {"t0": int(t0), "ticks": int(len(rec["live"]))}
+            line.update({f: rec[f].tolist() for f in TELEMETRY_FIELDS})
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(line) + "\n")
+
+    def series(self) -> dict:
+        """The concatenated per-tick series (dict of [T] arrays plus
+        ``t0``/``ticks``/``detections_cum``).  Reads the file back when
+        one is being written — a resumed recorder only saw the segments
+        after the resume point, but the file holds the whole run."""
+        if self.path and os.path.exists(self.path):
+            return read_timeline(self.path)
+        return _merge_chunks(self._chunks)
+
+
+def _merge_chunks(chunks) -> dict:
+    dedup = {}
+    for t0, rec in chunks:          # later flushes win (resume re-runs)
+        dedup[t0] = rec
+    if not dedup:
+        out = {f: np.zeros((0,), np.int64) for f in TELEMETRY_FIELDS}
+        out.update(t0=0, ticks=0, detections_cum=np.zeros((0,), np.int64))
+        return out
+    t0s = sorted(dedup)
+    out = {f: np.concatenate([dedup[t][f] for t in t0s])
+           for f in TELEMETRY_FIELDS}
+    out["t0"] = t0s[0]
+    out["ticks"] = int(sum(len(dedup[t]["live"]) for t in t0s))
+    # ``detections`` is per-tick (delta) so it stays segment-local exact
+    # on every backend (the sharded chunked driver resets its per-shard
+    # partials each segment); the so-far view is its running sum.
+    out["detections_cum"] = np.cumsum(out["detections"])
+    return out
+
+
+def read_timeline(path: str) -> dict:
+    """Parse ``timeline.jsonl`` into the merged per-tick series (see
+    :meth:`TimelineRecorder.series`).  Tolerates a torn trailing line
+    (crash mid-append) and duplicate ``t0`` records (kill/resume): the
+    last record per tick range wins."""
+    chunks = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue            # torn trailing write
+            chunks.append((int(rec["t0"]),
+                           {f: np.asarray(rec[f], np.int64)
+                            for f in TELEMETRY_FIELDS}))
+    return _merge_chunks(chunks)
+
+
+def timeline_summary(series: dict) -> dict:
+    """Aggregate view of a timeline (run_report's timeline section)."""
+    if not series or series.get("ticks", 0) == 0:
+        return {"ticks": 0}
+    det = series["detections"]
+    det_ticks = np.nonzero(det)[0]
+    return {
+        "ticks": int(series["ticks"]),
+        "t0": int(series["t0"]),
+        "joins_total": int(series["joins"].sum()),
+        "removals_total": int(series["removals"].sum()),
+        "detections_total": int(det.sum()),
+        "msgs_sent_total": int(series["msgs_sent"].sum()),
+        "msgs_recv_total": int(series["msgs_recv"].sum()),
+        "dropped_total": int(series["dropped"].sum()),
+        "probe_acks_total": int(series["probe_acks"].sum()),
+        "gossip_rows_total": int(series["gossip_rows"].sum()),
+        "live_min": int(series["live"].min()),
+        "live_max": int(series["live"].max()),
+        "suspected_peak": int(series["suspected"].max()),
+        "first_detection_tick": (int(series["t0"] + det_ticks[0])
+                                 if det_ticks.size else None),
+        "last_detection_tick": (int(series["t0"] + det_ticks[-1])
+                                if det_ticks.size else None),
+    }
+
+
+def scan_trace_for_phases(trace_dir: str, names=PHASE_NAMES) -> list:
+    """Which phase-annotation names appear in a captured profiler trace
+    (byte-scan of every file under ``trace_dir``, gzip-aware: the op
+    names carrying ``jax.named_scope`` prefixes are embedded verbatim in
+    the xplane protobuf / trace json)."""
+    want = {n: n.encode() for n in names}
+    found = set()
+    for root, _, files in os.walk(trace_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            try:
+                with open(path, "rb") as fh:
+                    blob = fh.read()
+                if fname.endswith(".gz"):
+                    try:
+                        blob = gzip.decompress(blob)
+                    except OSError:
+                        pass
+            except OSError:
+                continue
+            for name, pat in want.items():
+                if name not in found and pat in blob:
+                    found.add(name)
+    return sorted(found)

@@ -146,13 +146,16 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
         ],
         out_specs=pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
     )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((rows, s), U32),
-        interpret=interpret,
-    )(c_shifts.astype(I32), s1s.astype(I32), s2s.astype(I32),
-      mail, payloads, payloads)
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_GOSSIP)
+    with jax.named_scope(PHASE_GOSSIP):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((rows, s), U32),
+            interpret=interpret,
+        )(c_shifts.astype(I32), s1s.astype(I32), s2s.astype(I32),
+          mail, payloads, payloads)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -219,10 +222,13 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
         ],
         out_specs=pl.BlockSpec((b, s), row_block),
     )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((rows, s), U32),
-        interpret=interpret,
-    )(shifts.astype(I32), mail, payload, payload,
-      k_eff.astype(I32)[:, None], k_eff.astype(I32)[:, None])
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_GOSSIP)
+    with jax.named_scope(PHASE_GOSSIP):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((rows, s), U32),
+            interpret=interpret,
+        )(shifts.astype(I32), mail, payload, payload,
+          k_eff.astype(I32)[:, None], k_eff.astype(I32)[:, None])

@@ -136,11 +136,14 @@ def receive_core(n: int, s: int, tfail: int, tremove: int, stride: int,
     """Pure-jnp receive pass (reference AND default implementation).
     Takes the per-node vectors [N]-shaped; the column lifting/squeezing
     happens here so callers are unchanged."""
-    (new_view, new_ts, mail_cleared, join_mask, rm_ids, nf, sz) = \
-        _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
-                      mail, cand, recv_mask[:, None], act[:, None],
-                      self_on[:, None], self_pack[:, None],
-                      row_ids[:, None])
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_RECEIVE)
+    with jax.named_scope(PHASE_RECEIVE):
+        (new_view, new_ts, mail_cleared, join_mask, rm_ids, nf, sz) = \
+            _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
+                          mail, cand, recv_mask[:, None], act[:, None],
+                          self_on[:, None], self_pack[:, None],
+                          row_ids[:, None])
     return (new_view, new_ts, mail_cleared, join_mask, rm_ids,
             nf[:, 0], sz[:, 0])
 
@@ -201,34 +204,39 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
     # (_receive_body's column-vector contract).
     col_spec = pl.BlockSpec((b, 1), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # t
-            row_spec, row_spec, row_spec, row_spec,  # view, ts, mail, cand
-            col_spec, col_spec, col_spec,            # recv, act, self_on
-            col_spec, col_spec,                      # self_pack, row_ids
-        ],
-        out_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
-                   col_spec, col_spec],
-        # Donate the big state buffers in place (view->view, ts->ts,
-        # mail->mail_cleared): no duplicate [N, S] allocations live across
-        # the call — the point of an HBM-roofline kernel.  (Input index 0
-        # is the SMEM t scalar, so state inputs start at 1.)
-        input_output_aliases={1: 0, 2: 1, 3: 2},
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, s), U32),   # view
-            jax.ShapeDtypeStruct((rows, s), I32),   # view_ts
-            jax.ShapeDtypeStruct((rows, s), U32),   # mail cleared
-            jax.ShapeDtypeStruct((rows, s), I32),   # join mask (i32)
-            jax.ShapeDtypeStruct((rows, s), I32),   # rm ids
-            jax.ShapeDtypeStruct((rows, 1), I32),   # numfailed
-            jax.ShapeDtypeStruct((rows, 1), I32),   # size
-        ],
-        interpret=interpret,
-    )(jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
-      recv_mask.astype(I32)[:, None], act.astype(I32)[:, None],
-      self_on.astype(I32)[:, None], self_pack[:, None], row_ids[:, None])
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_RECEIVE)
+    with jax.named_scope(PHASE_RECEIVE):
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),   # t
+                row_spec, row_spec, row_spec, row_spec,  # view/ts/mail/cand
+                col_spec, col_spec, col_spec,            # recv, act, self_on
+                col_spec, col_spec,                      # self_pack, row_ids
+            ],
+            out_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
+                       col_spec, col_spec],
+            # Donate the big state buffers in place (view->view, ts->ts,
+            # mail->mail_cleared): no duplicate [N, S] allocations live
+            # across the call — the point of an HBM-roofline kernel.
+            # (Input index 0 is the SMEM t scalar, so state inputs start
+            # at 1.)
+            input_output_aliases={1: 0, 2: 1, 3: 2},
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, s), U32),   # view
+                jax.ShapeDtypeStruct((rows, s), I32),   # view_ts
+                jax.ShapeDtypeStruct((rows, s), U32),   # mail cleared
+                jax.ShapeDtypeStruct((rows, s), I32),   # join mask (i32)
+                jax.ShapeDtypeStruct((rows, s), I32),   # rm ids
+                jax.ShapeDtypeStruct((rows, 1), I32),   # numfailed
+                jax.ShapeDtypeStruct((rows, 1), I32),   # size
+            ],
+            interpret=interpret,
+        )(jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
+          recv_mask.astype(I32)[:, None], act.astype(I32)[:, None],
+          self_on.astype(I32)[:, None], self_pack[:, None],
+          row_ids[:, None])
     (view2, ts2, mailc, join_i, rm_ids, nf, size) = out
     return (view2, ts2, mailc, join_i != 0, rm_ids, nf[:, 0], size[:, 0])

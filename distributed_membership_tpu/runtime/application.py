@@ -25,7 +25,9 @@ def run_conf(conf_path: str, backend: str | None = None,
              seed: int | None = None, out_dir: str = ".",
              checkpoint_every: int | None = None,
              checkpoint_dir: str | None = None,
-             resume: bool | None = None) -> RunResult:
+             resume: bool | None = None,
+             telemetry: str | None = None,
+             telemetry_dir: str | None = None) -> RunResult:
     # Validation runs AFTER the CLI overrides merge: cross-field rules
     # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
     # effective config, not the conf file alone.
@@ -41,6 +43,12 @@ def run_conf(conf_path: str, backend: str | None = None,
         params.CHECKPOINT_DIR = checkpoint_dir
     if resume is not None:
         params.RESUME = int(resume)
+    # Flight-recorder knobs (observability/timeline.py, runlog.py): CLI
+    # overrides win, as the checkpoint keys above.
+    if telemetry is not None:
+        params.TELEMETRY = telemetry
+    if telemetry_dir is not None:
+        params.TELEMETRY_DIR = telemetry_dir
     params.validate()
     result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
     result.log.flush(out_dir)
@@ -176,6 +184,15 @@ def main(argv=None) -> int:
                     help="resume bit-exactly from --checkpoint-dir's "
                          "latest valid checkpoint (validated against this "
                          "config/seed; starts fresh when none exists)")
+    ap.add_argument("--telemetry", default=None,
+                    choices=["off", "scalars"],
+                    help="TELEMETRY conf key: 'scalars' arms the flight "
+                         "recorder's in-scan per-tick series on the ring "
+                         "backends (observability/timeline.py)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="TELEMETRY_DIR conf key: directory for "
+                         "timeline.jsonl / runlog.jsonl / summary.json "
+                         "(render with scripts/run_report.py)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                     help="pin the jax platform (e.g. cpu for hermetic runs on "
                          "a virtual device mesh)")
@@ -202,7 +219,9 @@ def main(argv=None) -> int:
                       out_dir=args.out_dir,
                       checkpoint_every=args.checkpoint_every,
                       checkpoint_dir=args.checkpoint_dir,
-                      resume=args.resume)
+                      resume=args.resume,
+                      telemetry=args.telemetry,
+                      telemetry_dir=args.telemetry_dir)
 
     summary = {
         "backend": result.params.BACKEND,
@@ -217,6 +236,8 @@ def main(argv=None) -> int:
     }
     if "detection_summary" in result.extra:
         summary["detection"] = result.extra["detection_summary"]
+    if result.extra.get("timeline_path"):
+        summary["timeline_path"] = result.extra["timeline_path"]
     if args.grade:
         g = SCENARIO_GRADERS[args.grade](result.log.dbg_text(),
                                          result.params.EN_GPSZ)

@@ -55,7 +55,11 @@ CRASH_ENV = "DM_CRASH_AT_TICK"
 # never affect per-tick math; bit-exactness is pinned across chunkings).
 _IDENTITY_EXCLUDE = frozenset(
     {"globaltime", "dropmsg", "CHECKPOINT_EVERY", "CHECKPOINT_DIR",
-     "RESUME", "CHECKPOINT_COMPRESS"})
+     "RESUME", "CHECKPOINT_COMPRESS",
+     # Telemetry is trajectory-inert by contract (tests/test_timeline.py
+     # pins bit-exactness on/off), so a resume may turn the flight
+     # recorder on or move its output dir without invalidating the run.
+     "TELEMETRY", "TELEMETRY_DIR"})
 
 
 def params_identity(params: Params) -> str:
@@ -296,7 +300,8 @@ def _crash_tick() -> Optional[int]:
 
 def chunked_run(params: Params, plan, seed: int, total: int, *,
                 init_carry, segment_fn, collect_events: bool,
-                compact_fn=None, event_type=None, finalize=None):
+                compact_fn=None, event_type=None, finalize=None,
+                telemetry_sink=None):
     """Run the tick loop in ``CHECKPOINT_EVERY``-tick segments.
 
     ``init_carry()`` builds the fresh device carry; ``segment_fn(carry,
@@ -311,6 +316,19 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
     complete) — the chunked home of run-total epilogues that ride the
     monolithic scan's tail on the unchunked path (tpu_hash's
     PROBE_IO approx_lag counter correction).
+
+    ``telemetry_sink(telem, t0)``, when given, marks the backend's
+    per-tick outputs as the pair ``(events, TickTelemetry-of-[K]-series)``
+    (TELEMETRY: scalars — observability/timeline.py): the telemetry half
+    is split off after the per-segment host flush and handed to the sink
+    with the segment's first tick, so timeline.jsonl grows at every
+    boundary and a kill loses at most the in-flight segment's series
+    (the resume re-runs and re-flushes it).
+
+    When ``params.TELEMETRY_DIR`` is set, per-segment timing events
+    (device-sync / flush / checkpoint-write-wait seconds) are appended to
+    ``<TELEMETRY_DIR>/runlog.jsonl`` (observability/runlog.py) for ANY
+    chunked backend, independent of the TELEMETRY knob.
 
     Checkpoint writes are double-buffered: the host ``np.savez`` of
     segment ``i`` runs on a background writer thread while segment
@@ -338,6 +356,8 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
         raise ValueError("pass exactly one of compact_fn/event_type")
     ckpt_dir = params.CHECKPOINT_DIR or None
     compress = bool(params.CHECKPOINT_COMPRESS)
+    from distributed_membership_tpu.observability.runlog import maybe_runlog
+    runlog = maybe_runlog(params.TELEMETRY_DIR or None)
 
     (ticks, keys, start_ticks, fail_mask, fail_time,
      drop_lo, drop_hi) = plan_tensors(params, plan, seed, total)
@@ -383,6 +403,11 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
             fut.result()    # surface writer exceptions on the main thread
 
     crash_at = _crash_tick()
+    if runlog is not None:
+        runlog.event("segments_start", backend=params.BACKEND,
+                     total=int(total), every=int(every),
+                     tick_start=int(start), resumed=bool(start > 0),
+                     checkpoint_dir=ckpt_dir or "")
     try:
         for a in range(start, total, every):
             if crash_at is not None and a >= crash_at:
@@ -396,6 +421,7 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                     f"last durable checkpoint: "
                     f"{manifest_tick(ckpt_dir) or 'none'}")
             b = min(a + every, total)
+            t_seg = time.perf_counter()
             carry, ev = segment_fn(carry, ticks[a:b], keys[a:b],
                                    start_ticks, fail_mask, fail_time,
                                    drop_lo, drop_hi)
@@ -404,6 +430,10 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
             # host for the snapshot.
             carry = jax.tree.map(np.asarray, carry)
             ev = jax.tree.map(np.asarray, ev)
+            t_sync = time.perf_counter()
+            if telemetry_sink is not None:
+                ev, telem = ev
+                telemetry_sink(telem, a)
             if compact_fn is not None:
                 acc = concat_compact([acc, compact_fn(ev, a)])
                 payload = {"joins": acc.joins, "removes": acc.removes,
@@ -414,19 +444,35 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                        tuple(np.concatenate([p, s])
                              for p, s in zip(acc, seg)))
                 payload = {f"s{i}": acc[i] for i in range(4)}
+            ckpt_wait_s = 0.0
             if ckpt_dir:
                 # Barrier for the PREVIOUS write, then hand this one to
                 # the writer; the next segment's dispatch overlaps it.
                 # (Each iteration rebinds carry/acc to fresh host
                 # arrays, so the submitted snapshot is never mutated.)
+                t_wait = time.perf_counter()
                 _await_writer()
+                ckpt_wait_s = time.perf_counter() - t_wait
                 pending = executor.submit(
                     _save_checkpoint, ckpt_dir, base, b,
                     jax.tree_util.tree_leaves(carry), payload, compress)
+            if runlog is not None:
+                # Per-boundary attribution: device_sync_s is dispatch +
+                # device compute + the host pull; ckpt_wait_s is write
+                # time the NEXT segment's compute failed to hide.
+                runlog.event(
+                    "segment", t0=int(a), t1=int(b),
+                    device_sync_s=round(t_sync - t_seg, 4),
+                    flush_s=round(
+                        time.perf_counter() - t_sync - ckpt_wait_s, 4),
+                    ckpt_wait_s=round(ckpt_wait_s, 4))
         _await_writer()
     finally:
         if executor is not None:
             executor.shutdown(wait=True)
+    if runlog is not None:
+        runlog.event("segments_done", total=int(total),
+                     tick_start=int(start))
 
     if finalize is not None and acc is not None and total > 0:
         carry, acc = finalize(carry, acc)

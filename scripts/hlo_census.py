@@ -39,7 +39,7 @@ if REPO not in sys.path:
 
 def census_params(n: int, s: int, *, rng_mode: str = "batched",
                   probe_gather: str = "packed", drops: bool = False,
-                  probe_io: str = "auto"):
+                  probe_io: str = "auto", telemetry: str = "off"):
     """The ladder's 1M_s16 step config (profile_step.py defaults) at
     (n, s), with the round-6 lowering knobs exposed.  ``drops`` arms the
     msgdrop-class coin streams — the regime where the batched plan
@@ -58,7 +58,8 @@ def census_params(n: int, s: int, *, rng_mode: str = "batched",
         f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
         f"FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
-        f"PROBE_IO: {probe_io}\nBACKEND: tpu_hash\n")
+        f"PROBE_IO: {probe_io}\nTELEMETRY: {telemetry}\n"
+        f"BACKEND: tpu_hash\n")
 
 
 def _walk_eqns(jaxpr, visit):
@@ -100,11 +101,22 @@ def step_census(params) -> dict:
            jax.ShapeDtypeStruct((), i32))
     traced = jax.jit(lambda st, inp: step(st, inp)).trace(state, inp)
 
+    s = params.VIEW_SIZE
     counts = {"threefry_calls": 0, "big_gathers": 0,
-              "big_gather_shapes": []}
+              "big_gather_shapes": [], "big_scatters": 0,
+              "total_eqns": 0, "ns_class_ops": 0}
 
     def visit(eqn):
         name = eqn.primitive.name
+        counts["total_eqns"] += 1
+        out_size = 1
+        for d in eqn.outvars[0].aval.shape:
+            out_size *= d
+        # Ops producing a full [N, S]-class tensor — the "pass" classes
+        # the telemetry census bounds (TELEMETRY on may add fusible
+        # elementwise masks under drops, never gathers/scatters/RNG).
+        if out_size >= n * max(s, 1):
+            counts["ns_class_ops"] += 1
         # Each random-bits draw is one threefry expansion at lowering:
         # the traced program carries it as `random_bits` (typed-key
         # path) or `threefry2x32` (raw counters) depending on the jax
@@ -112,17 +124,17 @@ def step_census(params) -> dict:
         if name in ("threefry2x32", "random_bits"):
             counts["threefry_calls"] += 1
         elif name == "gather":
-            size = 1
-            for d in eqn.outvars[0].aval.shape:
-                size *= d
-            if size >= n:
+            if out_size >= n:
                 counts["big_gathers"] += 1
                 counts["big_gather_shapes"].append(
                     list(eqn.outvars[0].aval.shape))
+        elif name.startswith("scatter"):
+            if out_size >= n:
+                counts["big_scatters"] += 1
 
     _walk_eqns(traced.jaxpr.jaxpr, visit)
     counts["n"] = n
-    counts["s"] = params.VIEW_SIZE
+    counts["s"] = s
     return counts
 
 

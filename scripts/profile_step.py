@@ -40,7 +40,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                fused_gossip: bool = False, folded: bool = False,
                prng: str = "threefry2x32", shift_set: int = 0,
                rng_mode: str = "batched",
-               probe_gather: str = "packed") -> dict:
+               probe_gather: str = "packed",
+               trace_dir: str = "", runlog=None) -> dict:
     import random as _pyrandom
 
     import jax
@@ -48,6 +49,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     from distributed_membership_tpu.backends.tpu_hash import (
         make_config, plan_fail_ids, run_scan)
     from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_NAMES, scan_trace_for_phases)
     from distributed_membership_tpu.runtime.failures import make_plan
 
     g = max(s // 4, 1)
@@ -92,17 +95,53 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         ckpt_fields = {"checkpoint_every": ck_every,
                        "resumed_from_tick": resumed_from}
 
+    point = {"n": n, "s": s, "ticks": ticks, "exchange": exchange}
+    if runlog is not None:
+        runlog.event("compile", phase="start", **point)
     t0 = time.perf_counter()
     final_state, _ = run_scan(warm_params, plan, seed=0,
                               collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     compile_wall = time.perf_counter() - t0
+    if runlog is not None:
+        runlog.event("compile", phase="done",
+                     compile_plus_first_run_s=round(compile_wall, 2),
+                     **point)
 
+    # Phase-scoped trace capture (flight recorder part 2): profile ONLY
+    # the timed run on the warm jit cache, so the banked perfetto trace
+    # is per-phase device time, not compilation.  The next served
+    # hardware window banks this automatically (tpu_ladder passes
+    # --trace-dir per rung).
+    trace_fields = {}
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
     final_state, _ = run_scan(timed_params, plan, seed=1,
                               collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     wall = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
+        n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+        phases = scan_trace_for_phases(trace_dir)
+        trace_fields = {
+            "trace_dir": trace_dir,
+            "trace_files": n_files,
+            # Which protocol-phase annotations (jax.named_scope names,
+            # observability/timeline.PHASE_NAMES) made it into the
+            # captured trace metadata — the attribution contract
+            # tests/test_trace_phases.py pins on CPU.
+            "trace_phases": phases,
+            "trace_phase_annotations_present":
+                set(PHASE_NAMES) <= set(phases),
+        }
+        if runlog is not None:
+            runlog.event("trace", **trace_fields)
+    if runlog is not None:
+        runlog.event("execute", wall_seconds=round(wall, 3),
+                     ms_per_tick=round(1000 * wall / ticks, 2), **point)
 
     # Mirror run_scan's config exactly (incl. fail_ids) so the --cost path
     # analyzes the same compiled program the timed run executed and hits
@@ -162,6 +201,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         "est_model_gb_per_tick": round(est_gb_per_tick, 3),
         "implied_hbm_gbps": round(est_gb_per_tick * ticks / wall, 1),
         **ckpt_fields,
+        **trace_fields,
         **measured,
     }
 
@@ -198,11 +238,26 @@ def main() -> int:
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
+    ap.add_argument("--trace-dir", default="",
+                    help="capture a jax.profiler trace of the TIMED run "
+                         "into this directory; the record reports which "
+                         "protocol-phase annotations "
+                         "(observability/timeline.PHASE_NAMES) landed in "
+                         "the trace metadata")
+    ap.add_argument("--runlog", default="",
+                    help="append structured compile/execute/trace events "
+                         "to this JSONL file "
+                         "(observability/runlog.RunLog)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
     from distributed_membership_tpu.runtime.platform import resolve_platform
     resolve_platform(pin=args.platform)
+
+    runlog = None
+    if args.runlog:
+        from distributed_membership_tpu.observability.runlog import RunLog
+        runlog = RunLog(args.runlog)
 
     ns = [args.n] if args.n else [1 << 16, 1 << 18, 1 << 20]
     fused_opts = {"off": [False], "on": [True],
@@ -215,7 +270,8 @@ def main() -> int:
                              folded=args.folded == "on", prng=args.prng,
                              shift_set=args.shift_set,
                              rng_mode=args.rng_mode,
-                             probe_gather=args.probe_gather)
+                             probe_gather=args.probe_gather,
+                             trace_dir=args.trace_dir, runlog=runlog)
             print(json.dumps(rec), flush=True)
     return 0
 
@@ -225,15 +281,17 @@ if __name__ == "__main__":
         sys.exit(main())
     except SystemExit:
         raise
-    except BaseException:
+    except BaseException as e:
         # The ladder daemon surfaces only the stderr tail; bank the full
-        # traceback where a later session can read it.
+        # traceback as a structured event in the ladder's rotating JSONL
+        # log (observability/runlog.py — replaces the old free-form
+        # artifacts/rung_errors.log) where run_report.py can render it.
         import traceback
 
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "artifacts", "rung_errors.log")
-        with open(path, "a") as fh:
-            fh.write(f"=== profile_step {sys.argv[1:]} "
-                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
-            traceback.print_exc(file=fh)
+        from distributed_membership_tpu.observability.runlog import RunLog
+        RunLog(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "artifacts",
+            "ladder_events.jsonl")).event(
+                "rung_error", script="profile_step", argv=sys.argv[1:],
+                error=repr(e)[:200], traceback=traceback.format_exc())
         raise

@@ -1,0 +1,230 @@
+"""Flight-recorder report: one markdown/JSON view of a recorded run.
+
+Renders the three recorder streams into a single report:
+
+  * ``timeline.jsonl`` (TELEMETRY: scalars — observability/timeline.py):
+    per-tick protocol health, summarized and reconciled against
+  * ``summary.json`` (the detection verdicts finish_run drops next to
+    the timeline), plus
+  * ``runlog.jsonl`` (observability/runlog.py): per-segment
+    wall / device-sync / checkpoint-write-overlap timings and
+    compile-vs-execute events, and optionally
+  * a ladder event log (``artifacts/ladder_events.jsonl``): per-rung
+    start/land/fail/retry/resume provenance.
+
+Usage:
+  python scripts/run_report.py --dir <TELEMETRY_DIR>            # markdown
+  python scripts/run_report.py --dir <dir> --json               # dict
+  python scripts/run_report.py --dir <dir> --out report.md
+  python scripts/run_report.py --ladder artifacts/ladder_events.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from distributed_membership_tpu.observability.runlog import (  # noqa: E402
+    read_events)
+from distributed_membership_tpu.observability.timeline import (  # noqa: E402
+    read_timeline, timeline_summary)
+
+
+def _segment_stats(events: list) -> dict:
+    segs = [e for e in events if e.get("kind") == "segment"]
+    if not segs:
+        return {}
+    dev = [e.get("device_sync_s", 0.0) for e in segs]
+    wait = [e.get("ckpt_wait_s", 0.0) for e in segs]
+    flush = [e.get("flush_s", 0.0) for e in segs]
+    out = {
+        "segments": len(segs),
+        "ticks_covered": sum(e["t1"] - e["t0"] for e in segs
+                             if "t0" in e and "t1" in e),
+        "device_sync_s_total": round(sum(dev), 3),
+        "device_sync_s_mean": round(sum(dev) / len(dev), 4),
+        "device_sync_s_max": round(max(dev), 4),
+        "ckpt_wait_s_total": round(sum(wait), 3),
+        "flush_s_total": round(sum(flush), 3),
+    }
+    compiles = [e for e in events if e.get("kind") == "compile"
+                and e.get("phase") == "done"]
+    if compiles:
+        out["compile_plus_first_run_s"] = [
+            e.get("compile_plus_first_run_s") for e in compiles]
+    resumed = [e for e in events if e.get("kind") == "segments_start"
+               and e.get("resumed")]
+    if resumed:
+        out["resumed_from_ticks"] = [e.get("tick_start") for e in resumed]
+    return out
+
+
+def _ladder_stats(events: list) -> dict:
+    rungs: dict = {}
+    for e in events:
+        name = e.get("rung")
+        if not name:
+            continue
+        r = rungs.setdefault(name, {"starts": 0, "timeouts": 0,
+                                    "retries": 0, "resumes": 0,
+                                    "errors": 0, "status": "pending"})
+        kind = e.get("kind")
+        if kind == "rung_start":
+            r["starts"] += 1
+        elif kind == "rung_timeout":
+            r["timeouts"] += 1
+        elif kind == "rung_retry":
+            r["retries"] += 1
+        elif kind == "rung_resume":
+            r["resumes"] += 1
+            r["resumed_from_tick"] = e.get("resumed_from_tick")
+        elif kind in ("rung_attempt_failed", "rung_error"):
+            r["errors"] += 1
+        elif kind == "rung_land":
+            r["status"] = "landed"
+            for k in ("node_ticks_per_sec", "ms_per_tick", "attempts"):
+                if e.get(k) is not None:
+                    r[k] = e[k]
+        elif kind == "rung_fail":
+            r["status"] = "failed"
+        elif kind == "rung_abandoned":
+            r["status"] = "abandoned"
+        elif kind == "correctness_failure":
+            r["status"] = "correctness_failure"
+    passes = [e for e in events if e.get("kind") == "pass_done"]
+    out = {"rungs": rungs}
+    if passes:
+        out["passes"] = len(passes)
+        out["landed_total"] = passes[-1].get("landed_total")
+    return out
+
+
+def build_report(directory: str | None,
+                 ladder_path: str | None = None) -> dict:
+    """Collect every recorder stream present into one dict."""
+    report: dict = {}
+    if directory:
+        tl_path = os.path.join(directory, "timeline.jsonl")
+        if os.path.exists(tl_path):
+            series = read_timeline(tl_path)
+            report["timeline"] = timeline_summary(series)
+            report["timeline"]["detections_so_far_final"] = (
+                int(series["detections_cum"][-1])
+                if len(series["detections_cum"]) else 0)
+        sm_path = os.path.join(directory, "summary.json")
+        if os.path.exists(sm_path):
+            with open(sm_path) as fh:
+                report["detection_summary"] = json.load(fh)
+        rl_path = os.path.join(directory, "runlog.jsonl")
+        if os.path.exists(rl_path):
+            report["segments"] = _segment_stats(read_events(rl_path))
+    if ladder_path and os.path.exists(ladder_path):
+        report["ladder"] = _ladder_stats(read_events(ladder_path))
+    # Reconciliation: the per-tick series must sum to the run verdicts
+    # (the acceptance contract tests/test_timeline.py pins).
+    tl, ds = report.get("timeline"), report.get("detection_summary")
+    if tl and ds:
+        report["reconciliation"] = {
+            "joins_match": tl["joins_total"] == ds.get("joins_total"),
+            "removals_match": tl["removals_total"] == (
+                ds.get("false_removals", 0)
+                + ds.get("detections_total", 0)),
+        }
+    return report
+
+
+def _md_kv(d: dict) -> list:
+    return [f"| {k} | {v} |" for k, v in d.items()]
+
+
+def render_markdown(report: dict) -> str:
+    lines = ["# Flight-recorder run report", ""]
+    tl = report.get("timeline")
+    if tl:
+        lines += ["## Timeline (per-tick telemetry)", "",
+                  "| metric | value |", "|---|---|"]
+        lines += _md_kv(tl)
+        lines.append("")
+    ds = report.get("detection_summary")
+    if ds:
+        lines += ["## Detection summary", "",
+                  "| metric | value |", "|---|---|"]
+        lines += _md_kv({k: v for k, v in ds.items()
+                         if not isinstance(v, dict)})
+        lines.append("")
+    rc = report.get("reconciliation")
+    if rc:
+        lines += ["## Timeline ↔ summary reconciliation", "",
+                  "| check | ok |", "|---|---|"]
+        lines += _md_kv(rc)
+        lines.append("")
+    seg = report.get("segments")
+    if seg:
+        lines += ["## Segment timings (chunked driver)", "",
+                  "| metric | value |", "|---|---|"]
+        lines += _md_kv(seg)
+        lines.append("")
+    lad = report.get("ladder")
+    if lad:
+        lines += ["## Ladder rungs", "",
+                  "| rung | status | starts | timeouts | retries | "
+                  "resumes | node-ticks/s |",
+                  "|---|---|---|---|---|---|---|"]
+        for name, r in sorted(lad["rungs"].items()):
+            lines.append(
+                f"| {name} | {r['status']} | {r['starts']} | "
+                f"{r['timeouts']} | {r['retries']} | {r['resumes']} | "
+                f"{r.get('node_ticks_per_sec', '')} |")
+        tail = {k: v for k, v in lad.items() if k != "rungs"}
+        if tail:
+            lines += [""] + ["| metric | value |", "|---|---|"]
+            lines += _md_kv(tail)
+        lines.append("")
+    if len(lines) <= 2:
+        lines.append("(no recorder artifacts found)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=None,
+                    help="flight-recorder directory (TELEMETRY_DIR): "
+                         "timeline.jsonl / summary.json / runlog.jsonl")
+    ap.add_argument("--ladder", default=None,
+                    help="ladder event log to render "
+                         "(artifacts/ladder_events.jsonl)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report dict as JSON instead of "
+                         "markdown")
+    ap.add_argument("--out", default=None,
+                    help="write the report to this file instead of "
+                         "stdout")
+    args = ap.parse_args()
+    if not args.dir and not args.ladder:
+        default_ladder = os.path.join(REPO, "artifacts",
+                                      "ladder_events.jsonl")
+        if os.path.exists(default_ladder):
+            args.ladder = default_ladder
+        else:
+            ap.error("pass --dir and/or --ladder")
+
+    report = build_report(args.dir, args.ladder)
+    text = (json.dumps(report, indent=1) if args.json
+            else render_markdown(report))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(args.out)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

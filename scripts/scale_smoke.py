@@ -67,9 +67,24 @@ def main() -> int:
                     help="mesh size for tpu_hash_sharded (0 = all devices); "
                          "forces the 8-device virtual CPU mesh when no "
                          "accelerator is available")
+    ap.add_argument("--telemetry", default="off",
+                    choices=["off", "scalars"],
+                    help="TELEMETRY: scalars arms the flight recorder's "
+                         "in-scan per-tick series "
+                         "(observability/timeline.py); the run record "
+                         "gains timeline totals")
+    ap.add_argument("--telemetry-dir", default="",
+                    help="directory for timeline.jsonl / runlog.jsonl / "
+                         "summary.json (implies --telemetry scalars; "
+                         "render with scripts/run_report.py)")
     ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
+    if args.telemetry_dir and args.telemetry == "off":
+        args.telemetry = "scalars"
+    if args.telemetry == "scalars" and args.backend == "tpu_sparse":
+        ap.error("--telemetry scalars requires a ring backend "
+                 "(tpu_hash / tpu_hash_sharded)")
 
     if args.backend == "tpu_hash_sharded":
         # Ensure a real mesh even on a CPU-only host: force the virtual
@@ -141,7 +156,9 @@ def main() -> int:
         f"FANOUT: {args.fanout}\nTFAIL: {tfail}\nTREMOVE: {tremove}\n"
         f"TOTAL_TIME: {args.ticks}\nFAIL_TIME: {fail_time}\n"
         f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: {args.exchange}\n"
-        f"SHIFT_SET: {args.shift_set}\nBACKEND: {args.backend}\n")
+        f"SHIFT_SET: {args.shift_set}\nTELEMETRY: {args.telemetry}\n"
+        f"TELEMETRY_DIR: {args.telemetry_dir}\n"
+        f"BACKEND: {args.backend}\n")
 
     t0 = time.time()
     result = get_backend(args.backend)(params, seed=args.seed)
@@ -177,6 +194,11 @@ def main() -> int:
         "detection": summary,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if "timeline" in result.extra:
+        from distributed_membership_tpu.observability.timeline import (
+            timeline_summary)
+        record["timeline"] = timeline_summary(result.extra["timeline"])
+        record["timeline_path"] = result.extra.get("timeline_path")
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     existing = []

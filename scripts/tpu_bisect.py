@@ -283,12 +283,12 @@ if __name__ == "__main__":
         sys.exit(main())
     except SystemExit:
         raise
-    except BaseException:
+    except BaseException as e:
         import traceback
 
-        with open(os.path.join(REPO, "artifacts", "rung_errors.log"),
-                  "a") as fh:
-            fh.write(f"=== tpu_bisect {sys.argv[1:]} "
-                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
-            traceback.print_exc(file=fh)
+        from distributed_membership_tpu.observability.runlog import RunLog
+        RunLog(os.path.join(REPO, "artifacts",
+                            "ladder_events.jsonl")).event(
+            "rung_error", script="tpu_bisect", argv=sys.argv[1:],
+            error=repr(e)[:200], traceback=traceback.format_exc())
         raise

@@ -215,15 +215,15 @@ if __name__ == "__main__":
         sys.exit(main())
     except SystemExit:
         raise
-    except BaseException:
+    except BaseException as e:
         # The ladder daemon surfaces only the stderr tail; bank the full
-        # traceback where a later session can read it.
-        import time
+        # traceback as a structured event in the ladder's rotating JSONL
+        # log (observability/runlog.py).
         import traceback
 
-        path = os.path.join(REPO, "artifacts", "rung_errors.log")
-        with open(path, "a") as fh:
-            fh.write(f"=== tpu_correctness {sys.argv[1:]} "
-                     f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
-            traceback.print_exc(file=fh)
+        from distributed_membership_tpu.observability.runlog import RunLog
+        RunLog(os.path.join(REPO, "artifacts",
+                            "ladder_events.jsonl")).event(
+            "rung_error", script="tpu_correctness", argv=sys.argv[1:],
+            error=repr(e)[:200], traceback=traceback.format_exc())
         raise

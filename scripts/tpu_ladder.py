@@ -18,6 +18,13 @@ robust to that:
     relay's next flake;
   * each completed rung appends to ``artifacts/TPU_PROFILE.json``
     immediately (crash-safe);
+  * the whole lifecycle — rung start/land/fail/timeout/retry/resume,
+    correctness failures, pass summaries, subprocess crash tracebacks —
+    streams into ONE rotating structured JSONL event log
+    (``artifacts/ladder_events.jsonl``, observability/runlog.py;
+    rendered by ``scripts/run_report.py``), and timing rungs bank a
+    per-phase perfetto trace under ``artifacts/traces/<rung>``
+    (profile_step ``--trace-dir``; LADDER_TRACE=0 disables);
   * ``--loop`` mode re-probes every ``--interval`` seconds and runs any
     missing rungs whenever the relay answers, until the ladder is complete
     or ``--max-hours`` elapses.
@@ -40,6 +47,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 OUT = os.path.join(REPO, "artifacts", "TPU_PROFILE.json")
+# Flight-recorder part 3 (observability/runlog.py): ONE rotating
+# structured JSONL event log for the whole ladder lifecycle — rung
+# start/land/fail/timeout/retry/resume, correctness failures, pass
+# summaries, and the subprocess crash tracebacks profile_step /
+# tpu_correctness / tpu_bisect bank on their own — replacing the
+# free-form ladder_daemon*.log prints + rung_errors.log dumps.
+# scripts/run_report.py renders it.
+EVENTS_PATH = os.path.join(REPO, "artifacts", "ladder_events.jsonl")
+# Per-rung perfetto traces (profile_step --trace-dir): the served
+# hardware window banks per-phase attribution automatically.
+# LADDER_TRACE=0 disables the capture.
+TRACE_ROOT = os.path.join(REPO, "artifacts", "traces")
+
+
+def _events():
+    from distributed_membership_tpu.observability.runlog import RunLog
+    return RunLog(EVENTS_PATH)
 
 # (name, n, view, ticks, mode, timeout_s) — smallest first; timeouts
 # sized ~4x the expected wall so a hung relay is cut quickly.  mode:
@@ -304,7 +328,16 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
     # just without resume.
     timing = not (name in CORRECTNESS_ARMS or name == LAYOUT_RUNG[0]
                   or name.startswith("bisect_"))
+    if timing and os.environ.get("LADDER_TRACE", "1") not in ("", "0"):
+        # Bank a per-phase perfetto trace + structured compile/execute
+        # events for every served timing rung (flight recorder parts
+        # 2 + 3); LADDER_TRACE=0 opts out.
+        cmd += ["--trace-dir", os.path.join(TRACE_ROOT, name),
+                "--runlog", EVENTS_PATH]
     ckpt_dir = _rung_ckpt_dir(name) if timing else None
+    events = _events()
+    events.event("rung_start", rung=name, n=n, s=s, ticks=ticks,
+                 mode=fused, timeout_s=timeout)
     attempt_log = []
     rec = None
     for attempt in range(1, MAX_ATTEMPTS + 1):
@@ -318,7 +351,13 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
             env["DM_RESUME"] = "1"
         attempt_log.append({"attempt": attempt,
                             "resumed_from_tick": resumed_from})
+        if resumed_from:
+            events.event("rung_resume", rung=name, attempt=attempt,
+                         resumed_from_tick=resumed_from)
         r, timed_out = _attempt(name, cmd, timeout, env)
+        if timed_out:
+            events.event("rung_timeout", rung=name, attempt=attempt,
+                         timeout_s=timeout)
         if not timed_out:
             if r.returncode == 0:
                 try:
@@ -340,11 +379,18 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                         rec["rung"] = name
                         rec["timestamp"] = time.strftime(
                             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                        events.event(
+                            "correctness_failure", rung=name,
+                            mismatched=rec["mismatched_elements"])
                         return rec
                 except (json.JSONDecodeError, IndexError):
                     pass
                 rec = None
             tail = (r.stderr or "").strip().splitlines()[-40:]
+            if r.returncode != 0:
+                events.event("rung_attempt_failed", rung=name,
+                             attempt=attempt, rc=r.returncode,
+                             stderr_tail="\n".join(tail[-8:]))
             print(f"  rung {name}: rc={r.returncode}\n    "
                   + "\n    ".join(tail), flush=True)
         if attempt >= MAX_ATTEMPTS:
@@ -355,14 +401,20 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
             # checkpoint survives, so the eventual retry still resumes).
             print(f"  rung {name}: relay not serving — abandoning "
                   "retries this pass", flush=True)
+            events.event("rung_abandoned", rung=name, attempt=attempt,
+                         reason="relay_not_serving")
             return None
         delay = _backoff_delay(attempt)
         attempt_log[-1]["backoff_s"] = round(delay, 1)
+        events.event("rung_retry", rung=name, attempt=attempt,
+                     backoff_s=round(delay, 1),
+                     resumes=bool(ckpt_dir))
         print(f"  rung {name}: attempt {attempt}/{MAX_ATTEMPTS} "
               f"interrupted; backing off {delay:.0f}s then "
               f"{'resuming' if ckpt_dir else 'retrying'}", flush=True)
         time.sleep(delay)
     if rec is None:
+        events.event("rung_fail", rung=name, attempts=len(attempt_log))
         return None
     rec["rung"] = name
     rec["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -378,6 +430,11 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         # A completed rung's stale checkpoint would make a future re-run's
         # warmup resume a finished scan (skipping the jit warm) — drop it.
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+    events.event(
+        "rung_land", rung=name, attempts=rec["attempts"],
+        node_ticks_per_sec=rec.get("node_ticks_per_sec"),
+        ms_per_tick=rec.get("ms_per_tick"),
+        trace_phases=rec.get("trace_phases"))
     return rec
 
 
@@ -509,6 +566,8 @@ def one_pass() -> tuple[int, int]:
     if platform != "tpu":
         print(f"probe: platform={platform!r} — relay not serving TPU",
               flush=True)
+        _events().event("probe", platform=platform,
+                        missing=len(missing))
         return 0, len(missing)
     landed = 0
     pending = list(missing)
@@ -553,6 +612,8 @@ def main() -> int:
     while True:
         landed, missing = one_pass()
         landed_total += landed
+        _events().event("pass_done", landed=landed,
+                        landed_total=landed_total, missing=missing)
         print(f"pass done: landed={landed} (total {landed_total}) "
               f"missing={missing}", flush=True)
         if not args.loop or missing == 0 or time.time() > deadline:

@@ -48,6 +48,37 @@ def test_1m_s16_census_reduced_counts():
 
 
 @pytest.mark.quick
+def test_telemetry_off_is_op_count_identical_and_on_is_bounded():
+    """Flight-recorder structural contract at the [1M, 16] north-star
+    geometry: ``TELEMETRY: off`` must lower to an OP-COUNT-IDENTICAL
+    program (every counter, including total_eqns — telemetry can never
+    tax the default path), and ``TELEMETRY: scalars`` may add only
+    fusible elementwise/reduce ops — zero new threefry invocations,
+    zero new [N]-class gathers or scatters, and a small bounded number
+    of [N, S]-output elementwise ops (the drop-mask intersections; no
+    new memory passes)."""
+    for drops in (False, True):
+        base = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops))
+        off = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops, telemetry="off"))
+        assert off == base, (off, base)
+
+        on = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops, telemetry="scalars"))
+        assert on["threefry_calls"] == base["threefry_calls"]
+        assert on["big_gathers"] == base["big_gathers"]
+        assert on["big_gather_shapes"] == base["big_gather_shapes"]
+        assert on["big_scatters"] == base["big_scatters"]
+        # Scalars only: the [N, S]-class additions are the handful of
+        # boolean drop-mask intersections feeding reductions (~1 per
+        # coin site), all fused into existing elementwise chains.
+        assert 0 <= (on["ns_class_ops"] - base["ns_class_ops"]) <= 16, (
+            on["ns_class_ops"], base["ns_class_ops"])
+        assert on["total_eqns"] > base["total_eqns"]   # counters exist
+
+
+@pytest.mark.quick
 def test_census_exact_mode_single_gather():
     """PROBE_IO exact (the default below 2^17) also rides the single
     combined gather — the DEFAULT exact path was the tentpole's target,

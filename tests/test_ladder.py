@@ -21,6 +21,9 @@ def _load_ladder(tmp_path):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.OUT = str(tmp_path / "TPU_PROFILE.json")
+    # Keep the structured event log + trace capture hermetic too.
+    mod.EVENTS_PATH = str(tmp_path / "ladder_events.jsonl")
+    mod.TRACE_ROOT = str(tmp_path / "traces")
     return mod
 
 

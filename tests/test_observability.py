@@ -1,0 +1,256 @@
+"""Observability satellites: msgcount scaling, dbg-log parsing
+robustness, summary edge cases, FastAgg/AggStats parity, and the
+structured run/ladder event log.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.observability.aggregates import (
+    LAT_BINS, detection_summary, fast_summary, init_agg, init_fast_agg,
+    latency_stats, update_agg, update_fast_agg)
+from distributed_membership_tpu.observability.metrics import (
+    MSGCOUNT_FULL_MATRIX_MAX, removal_latencies, write_msgcount)
+from distributed_membership_tpu.observability.runlog import (
+    RunLog, read_events)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+
+class _Result:
+    def __init__(self, sent, recv):
+        self.sent, self.recv = sent, recv
+
+
+# ---------------------------------------------------------------------------
+# write_msgcount: totals-only mode above the N threshold.
+
+def test_msgcount_small_n_keeps_full_matrix(tmp_path):
+    sent = np.arange(6, dtype=np.int32).reshape(2, 3)
+    recv = sent + 1
+    path = write_msgcount(_Result(sent, recv), str(tmp_path))
+    text = open(path).read()
+    assert "(   0,    1)" in text          # per-tick pairs retained
+    assert "node   1 sent_total" in text
+    assert "recv_total" in text
+
+
+def test_msgcount_large_n_totals_only(tmp_path):
+    n = MSGCOUNT_FULL_MATRIX_MAX + 1
+    sent = np.ones((n, 2), np.int32)
+    recv = 2 * sent
+    path = write_msgcount(_Result(sent, recv), str(tmp_path))
+    text = open(path).read()
+    assert "(" not in text                 # no per-tick pair matrix
+    lines = [ln for ln in text.splitlines() if ln]
+    assert len(lines) == n                 # one totals line per node
+    assert "sent_total      2  recv_total      4" in lines[0]
+
+
+def test_msgcount_explicit_override_beats_auto(tmp_path):
+    sent = np.ones((2, 2), np.int32)
+    path = write_msgcount(_Result(sent, sent), str(tmp_path),
+                          totals_only=True)
+    assert "(" not in open(path).read()
+
+
+# ---------------------------------------------------------------------------
+# removal_latencies: anchored on the reference phrasing.
+
+DBG_FIXTURE = """131
+ 1.0.0.0:0 [2] Node failed at time=2
+ 8.0.0.0:0 [3] Node failed at time = 3
+ 2.0.0.0:0 [23] Node 1.0.0.0:0 removed at time 23
+[worker3] 3.0.0.0:0 [25] Node 1.0.0.0:0 removed at time 25
+ 4.0.0.0:0 [30] Node 9.9.9.9:0 removed at time 30
+ junk line mentioning removed without structure
+ 5.0.0.0:0 [31] Node 1.0.0.0:0 was removed maybe
+ 6.0.0.0:0 [12] Node 8.0.0.0:0 removed at time 12
+"""
+
+
+def test_removal_latencies_anchored_and_skips_nonconforming():
+    lats = removal_latencies(DBG_FIXTURE, fail_time=2)
+    # Conforming removals of failed nodes only: ticks 23 and 25 (the
+    # variant "[worker3]" logger prefix must parse via the anchored
+    # phrasing, where positional parts[3]/parts[1] mis-read), plus the
+    # multi-failure-phrasing node 8 removal at tick 12.  The non-failed
+    # node, the junk line and the non-reference phrasing are skipped.
+    assert sorted(lats) == [10, 21, 23]
+
+
+def test_removal_latencies_reference_shape_unchanged():
+    """The exact lines the EventLog emits keep their pre-hardening
+    result (grader-parity regression guard)."""
+    from distributed_membership_tpu.eventlog import EventLog
+    log = EventLog()
+    log.node_failed_single(3, 7)
+    log.node_remove(1, 3, 29)
+    log.node_remove(2, 3, 30)
+    log.node_remove(2, 5, 30)       # not failed
+    assert sorted(removal_latencies(log.dbg_text(), 7)) == [22, 23]
+
+
+# ---------------------------------------------------------------------------
+# latency_stats / detection_summary edge cases.
+
+def test_latency_stats_empty_histogram():
+    assert latency_stats(np.zeros(LAT_BINS, np.int32)) == {}
+
+
+def test_latency_stats_single_detection():
+    hist = np.zeros(LAT_BINS, np.int32)
+    hist[21] = 1
+    s = latency_stats(hist)
+    assert (s["latency_min"], s["latency_max"]) == (21, 21)
+    assert (s["latency_p50"], s["latency_p99"]) == (21, 21)
+    assert s["latency_overflow_count"] == 0
+    assert s["latency_hist_nonzero"] == {21: 1}
+
+
+def test_latency_stats_overflow_bin():
+    hist = np.zeros(LAT_BINS, np.int32)
+    hist[5] = 1
+    hist[LAT_BINS - 1] = 3
+    s = latency_stats(hist)
+    assert s["latency_overflow_count"] == 3
+    assert s["latency_max"] == LAT_BINS - 1
+
+
+def test_detection_summary_no_detections_has_no_latency_keys():
+    n = 4
+    agg = init_agg(n)
+    fail_mask = np.zeros(n, bool)
+    fail_mask[1] = True
+    s = detection_summary(agg, fail_mask, fail_time=3)
+    assert s["false_removals"] == 0
+    assert s["detections_total"] == 0
+    assert "latency_p50" not in s
+
+
+def _synthetic_run(n=8, m=4, fail_time=3, ticks=7):
+    """Feed the SAME per-tick event tensors through both aggregate
+    paths; returns (AggStats, FastAgg, fail_mask, fail_ids)."""
+    fail_ids = (2,)
+    fail_mask_np = np.zeros(n, bool)
+    fail_mask_np[2] = True
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    agg = init_agg(n)
+    fagg = init_fast_agg(len(fail_ids), n)
+    fail_time_j = jnp.asarray(fail_time)
+    for py_t in range(ticks):
+        t = jnp.asarray(py_t)
+        view_ids = rng.randint(0, n, size=(n, m)).astype(np.int32)
+        view_present = rng.rand(n, m) < 0.9
+        if py_t == fail_time:
+            # Rows 0, 1, 4 track the to-be-crashed id at the census tick
+            # (row 2 is the crashed holder itself — excluded).
+            for row in (0, 1, 4, 2):
+                view_ids[row, 0] = 2
+                view_present[row, 0] = True
+        rm = np.full((n, m), -1, np.int32)
+        if py_t == 1:
+            rm[3, 0] = 4                   # false removal (live id)
+        if py_t == 2:
+            rm[4, 1] = 2                   # false: before the crash
+        if py_t == 5:
+            rm[0, 0] = 2                   # true detections
+            rm[1, 1] = 2
+        join = np.full((n, m), -1, np.int32)
+        if py_t == 0:
+            join[5, 2] = 6
+        sent = rng.randint(0, 5, n).astype(np.int32)
+        recv = rng.randint(0, 5, n).astype(np.int32)
+        agg = update_agg(
+            agg, t=t, join_ids=join, rm_ids=rm, view_ids=view_ids,
+            view_present=view_present, fail_mask=fail_mask_np,
+            fail_time=fail_time_j, sent_tick=sent, recv_tick=recv)
+        fagg = update_fast_agg(
+            fagg, t=t, fail_ids=fail_ids, join_events=(join >= 0),
+            rm_ids=rm, view_ids=view_ids, view_present=view_present,
+            fail_time=fail_time_j, holder_failed=fail_mask_np,
+            sent_tick=sent, recv_tick=recv)
+    return agg, fagg, fail_mask_np, fail_ids
+
+
+def test_fast_and_full_agg_summary_key_parity():
+    """FastAgg and AggStats summaries over the SAME event stream must
+    agree on every shared key — the scale path's summary is a drop-in
+    for the scatter-based one."""
+    agg, fagg, fail_mask, fail_ids = _synthetic_run()
+    s_full = detection_summary(agg, fail_mask, fail_time=3)
+    s_fast = fast_summary(fagg, fail_ids, fail_time=3)
+    assert set(s_fast) == set(s_full)
+    for k in s_full:
+        assert s_fast[k] == s_full[k], (k, s_fast[k], s_full[k])
+    # Sanity on the scenario itself: 2 true detections, 2 false
+    # removals (one pre-crash removal of the crashed id), 1 join.
+    assert s_full["detections_total"] == 2
+    assert s_full["false_removals"] == 2
+    assert s_full["joins_total"] == 1
+    assert s_full["latency_p50"] == 2          # t=5 - fail_time=3
+
+
+# ---------------------------------------------------------------------------
+# RunLog: rotation + torn-line tolerance + run_report rendering.
+
+def test_runlog_rotates_and_reads_back(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = RunLog(path, max_bytes=400, keep=2)
+    for i in range(30):
+        log.event("tick", i=i)
+    assert os.path.exists(path + ".1")         # rotated at least once
+    events = read_events(path)
+    assert [e["kind"] for e in events] == ["tick"] * len(events)
+    # Newest generation ends with the last event; rotated ones load too.
+    assert events[-1]["i"] == 29
+    assert len(events) >= 5
+
+
+def test_runlog_skips_torn_lines(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = RunLog(path)
+    log.event("ok", x=1)
+    with open(path, "a") as fh:
+        fh.write('{"kind": "torn", "x"')
+    log.event("ok", x=2)
+    assert [e["x"] for e in read_events(path, kinds={"ok"})] == [1, 2]
+
+
+def test_run_report_renders_ladder_events(tmp_path):
+    import run_report
+
+    path = str(tmp_path / "ladder_events.jsonl")
+    log = RunLog(path)
+    log.event("rung_start", rung="65k_s16", n=65536, s=16)
+    log.event("rung_timeout", rung="65k_s16", attempt=1, timeout_s=240)
+    log.event("rung_retry", rung="65k_s16", attempt=1, backoff_s=20.0,
+              resumes=True)
+    log.event("rung_resume", rung="65k_s16", attempt=2,
+              resumed_from_tick=90)
+    log.event("rung_land", rung="65k_s16", attempts=2,
+              node_ticks_per_sec=1e6, ms_per_tick=8.0)
+    log.event("rung_start", rung="1M_s16", n=1 << 20, s=16)
+    log.event("rung_fail", rung="1M_s16", attempts=3)
+    log.event("rung_error", rung=None, script="profile_step",
+              error="RuntimeError('relay')", traceback="...")
+    log.event("pass_done", landed=1, landed_total=1, missing=1)
+
+    report = run_report.build_report(None, path)
+    rungs = report["ladder"]["rungs"]
+    assert rungs["65k_s16"]["status"] == "landed"
+    assert rungs["65k_s16"]["timeouts"] == 1
+    assert rungs["65k_s16"]["resumes"] == 1
+    assert rungs["65k_s16"]["resumed_from_tick"] == 90
+    assert rungs["1M_s16"]["status"] == "failed"
+    assert report["ladder"]["landed_total"] == 1
+    md = run_report.render_markdown(report)
+    assert "65k_s16" in md and "landed" in md and "failed" in md
