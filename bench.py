@@ -239,6 +239,80 @@ def leg_hash(n: int, ticks: int, pin: str | None,
             "telemetry_overhead_pct": round(
                 100 * (tel_wall - base_best) / max(base_best, 1e-9), 1),
         })
+    # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
+    # (scenario/compile.py) at this leg's geometry, isolating the two
+    # cost classes:
+    #   * scenario_partition_overhead_pct — a half/half partition
+    #     window vs the plain leg: the engine's own elementwise masking
+    #     (no coins drawn — the <= 5% ISSUE bound);
+    #   * scenario_flake_overhead_pct — partition + cross-half link
+    #     flake vs a DROP-MATCHED baseline (conf-window drops at the
+    #     same probability/window): the flake's per-link range masks
+    #     over and above the coin streams any droppy run already pays
+    #     (comparing it against the drop-FREE base would mis-bill the
+    #     armed RNG streams to the scenario engine).
+    # Interleaved best-of-R, as the telemetry leg.
+    if os.environ.get("BENCH_SCENARIO", "0") not in ("", "0"):
+        import json as _json
+        import tempfile as _tf
+
+        from distributed_membership_tpu.runtime.failures import (
+            resolve_plan)
+        fl_lo, fl_hi = ticks // 2, (3 * ticks) // 4
+        part_ev = [{"kind": "partition", "start": ticks // 4,
+                    "stop": ticks // 2,
+                    "groups": [[0, n // 2], [n // 2, n]]}]
+        flake_ev = part_ev + [
+            {"kind": "link_flake", "start": fl_lo, "stop": fl_hi,
+             "src": [0, n // 2], "dst": [n // 2, n], "drop_prob": 0.05}]
+
+        def _scn_params(events):
+            with _tf.NamedTemporaryFile("w", suffix=".json",
+                                        delete=False) as fh:
+                _json.dump({"name": "bench", "events": events}, fh)
+                path = fh.name
+            p = Params.from_text(params_text + f"SCENARIO: {path}\n")
+            return p, resolve_plan(p, _pyrandom.Random("app:0")), path
+
+        p_part, plan_part, f1 = _scn_params(part_ev)
+        p_flake, plan_flake, f2 = _scn_params(flake_ev)
+        params_droppy = Params.from_text(
+            params_text.replace("DROP_MSG: 0", "DROP_MSG: 1")
+            .replace("MSG_DROP_PROB: 0", "MSG_DROP_PROB: 0.05")
+            + f"DROP_START: {fl_lo}\nDROP_STOP: {fl_hi}\n")
+        from distributed_membership_tpu.runtime.failures import make_plan
+        plan_droppy = make_plan(params_droppy, _pyrandom.Random("app:0"))
+        try:
+            reps = int(os.environ.get("BENCH_SCENARIO_REPS", "3"))
+            walls = {"base": wall, "part": None, "droppy": None,
+                     "flake": None}
+            arms = (("part", p_part, plan_part),
+                    ("droppy", params_droppy, plan_droppy),
+                    ("flake", p_flake, plan_flake))
+            for i in range(reps):
+                if i > 0:
+                    b, _ = _timed_runs(run_scan, params, plan, ticks)
+                    walls["base"] = min(walls["base"], b)
+                for name, pp, pl in arms:
+                    w, _ = _timed_runs(run_scan, pp, pl, ticks)
+                    walls[name] = (w if walls[name] is None
+                                   else min(walls[name], w))
+            ckpt_fields.update({
+                "scenario_partition_wall_seconds": round(
+                    walls["part"], 3),
+                "scenario_partition_overhead_pct": round(
+                    100 * (walls["part"] - walls["base"])
+                    / max(walls["base"], 1e-9), 1),
+                "scenario_flake_wall_seconds": round(walls["flake"], 3),
+                "scenario_droppy_baseline_wall_seconds": round(
+                    walls["droppy"], 3),
+                "scenario_flake_overhead_pct": round(
+                    100 * (walls["flake"] - walls["droppy"])
+                    / max(walls["droppy"], 1e-9), 1),
+            })
+        finally:
+            os.unlink(f1)
+            os.unlink(f2)
     if os.environ.get("BENCH_RNG", "0") not in ("", "0"):
         ckpt_fields.update(_bench_rng_micro(
             make_config(params, collect_events=False)))

@@ -50,7 +50,8 @@ from distributed_membership_tpu.addressing import INTRODUCER_ID, index_to_id
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
-from distributed_membership_tpu.runtime.failures import FailurePlan, log_failures, make_plan
+from distributed_membership_tpu.runtime.failures import (
+    FailurePlan, log_failures, resolve_plan)
 
 # Wire sizes (bytes), for buffer accounting only.
 LIST_MSG_SIZE = 19      # hdr 4 + addr 6 + pad 1 + heartbeat 8 (MP1Node.cpp:364)
@@ -70,14 +71,30 @@ class EmulNetwork:
         n = params.EN_GPSZ
         self.sent = np.zeros((n + 1, total_time), dtype=np.int64)
         self.recv = np.zeros((n + 1, total_time), dtype=np.int64)
+        # General-path scenario (scenario/compile.ScenarioHost): owns
+        # the drop windows, partitions, and link flakes when set; the
+        # legacy dropmsg toggle never fires then (the plan carries no
+        # drop window).
+        self.scenario = None
 
     def send(self, src_id: int, dst_id: int, payload: tuple, size: int, t: int) -> int:
         """ENsend (EmulNet.cpp:87-118): drop on full buffer, oversize, or
-        Bernoulli when the drop window is open; count only accepted sends."""
+        Bernoulli when the drop window is open; count only accepted sends.
+        With a general scenario attached, partition cuts drop the message
+        deterministically and the Bernoulli threshold is the per-link
+        effective percentage (windows + flakes)."""
         p = self.params
         if (len(self.buff) >= p.EN_BUFFSIZE
-                or size + EN_MSG_HDR >= p.MAX_MSG_SIZE
-                or (p.dropmsg and self.rng.randrange(100) < int(p.MSG_DROP_PROB * 100))):
+                or size + EN_MSG_HDR >= p.MAX_MSG_SIZE):
+            return 0
+        if self.scenario is not None:
+            si, di = src_id - 1, dst_id - 1        # EmulNet ids are idx+1
+            if self.scenario.blocked(t, si, di):
+                return 0
+            pct = self.scenario.drop_pct(t, si, di)
+            if pct and self.rng.randrange(100) < pct:
+                return 0
+        elif p.dropmsg and self.rng.randrange(100) < int(p.MSG_DROP_PROB * 100):
             return 0
         self.buff.append((src_id, dst_id, payload, size))
         self.sent[src_id, t] += 1
@@ -296,7 +313,12 @@ def run_emul(params: Params, log: Optional[EventLog] = None,
     for node in nodes:
         log.log(node.id, 0, "APP")  # constructor APP lines (Application.cpp:67)
 
-    plan = make_plan(params, rng_app)
+    plan = resolve_plan(params, rng_app)
+    scn_prog = getattr(plan, "scenario", None)
+    host = None
+    if scn_prog is not None:
+        host = scn_prog.host()
+        net.scenario = host
     starts = [params.start_tick(i) for i in range(n)]
 
     for t in range(total):
@@ -311,16 +333,29 @@ def run_emul(params: Params, log: Optional[EventLog] = None,
                 nodes[i].node_loop(t)
                 if i == 0 and t % 500 == 0:
                     log.log(nodes[i].id, t, f"@@time={t}")  # Application.cpp:156-160
-        _inject(plan, nodes, params, log, t)
+        if host is not None:
+            _inject_scenario(host, nodes, log, t)
+        else:
+            _inject(plan, nodes, params, log, t)
 
+    extra = {"final_lists": {node.id: [list(e) for e in node.members]
+                             for node in nodes}}
+    if scn_prog is not None:
+        from distributed_membership_tpu.scenario.oracle import (
+            scenario_report)
+        extra["scenario_report"] = scenario_report(
+            scn_prog, params, dbg_text=log.dbg_text(),
+            final_live=sum(1 for nd in nodes
+                           if nd.inited and nd.in_group and not nd.failed),
+            final_failed=sum(1 for nd in nodes if nd.failed),
+            final_failed_indices=[nd.idx for nd in nodes if nd.failed])
     return RunResult(
         params=params, log=log,
         sent=net.sent[1:, :], recv=net.recv[1:, :],
         failed_indices=plan.failed_indices if plan.fail_time is not None else [],
         fail_time=plan.fail_time,
         wall_seconds=_time.time() - t0,
-        extra={"final_lists": {node.id: [list(e) for e in node.members]
-                               for node in nodes}},
+        extra=extra,
     )
 
 
@@ -334,3 +369,27 @@ def _inject(plan: FailurePlan, nodes, params: Params, log: EventLog, t: int) -> 
             nodes[i].failed = True
     if plan.drop_stop is not None and t == plan.drop_stop:
         params.dropmsg = 0
+
+
+def _inject_scenario(host, nodes, log: EventLog, t: int) -> None:
+    """End-of-tick scenario transitions (scenario/compile.ScenarioHost)
+    — the host twin of the jitted steps' up/down block.  Crash/leave
+    nodes go dark (reference-faithfully: the queue strands); restarted
+    nodes come back as a fresh incarnation: empty member list with only
+    their own entry, heartbeat bumped past anything the old incarnation
+    gossiped, warm rejoin (in-group, no introducer round trip)."""
+    from distributed_membership_tpu.addressing import index_to_id
+
+    for i in host.down_at(t):
+        if not nodes[i].failed:
+            log.node_failed_multi(index_to_id(i), t)
+        nodes[i].failed = True
+    for i in host.up_at(t):
+        node = nodes[i]
+        node.failed = False
+        node.inited = True
+        node.in_group = True
+        node.hb = max(node.hb, 2 * (t + 1))
+        node.members = []
+        node.queue.clear()
+        node._update_my_pos(t)

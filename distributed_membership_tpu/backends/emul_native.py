@@ -34,7 +34,7 @@ from distributed_membership_tpu.addressing import INTRODUCER_INDEX
 from distributed_membership_tpu.backends import RunResult, register
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
-from distributed_membership_tpu.runtime.failures import log_failures, make_plan
+from distributed_membership_tpu.runtime.failures import log_failures, resolve_plan
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -116,7 +116,7 @@ def run_emul_native(params: Params, log: Optional[EventLog] = None,
     log = log if log is not None else EventLog()
     # Same failure-plan RNG stream as every other backend: identical seeds
     # crash identical nodes across backends.
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     n = params.EN_GPSZ
     total = params.TOTAL_TIME

@@ -52,7 +52,7 @@ from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.ops.merge import broadcast_deliver, fanout_deliver_indexed
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, log_failures, make_plan, plan_tensors)
+    FailurePlan, log_failures, plan_tensors, resolve_plan)
 
 I32 = jnp.int32
 
@@ -386,7 +386,7 @@ def run_tpu(params: Params, log: Optional[EventLog] = None,
     log = log if log is not None else EventLog()
     # Same failure-plan RNG stream as the emul backend: identical seeds fail
     # identical nodes, making runs directly comparable across backends.
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     final_state, events = run_scan(params, plan, seed)
     events_to_log(params, plan, events, log)

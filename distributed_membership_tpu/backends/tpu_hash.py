@@ -102,7 +102,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import (
     EMPTY, STRIDE, hash_slot)
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, make_plan, make_run_key, plan_tensors)
+    FailurePlan, make_run_key, plan_tensors, resolve_plan)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -362,6 +362,19 @@ class HashConfig:
     #                              so the off program is op-identical to
     #                              the pre-flight-recorder lowering
     #                              (tests/test_hlo_census.py).  Ring only.
+    scenario: object = None      # General-path scenario structural
+    #                              descriptor (scenario/compile.py
+    #                              ScenarioStatic — hashable, so it keys
+    #                              the runner caches).  When set, the
+    #                              step takes the ScenarioTensors plan as
+    #                              an 8th input and applies crash/restart
+    #                              transitions, the partition cross-group
+    #                              send mask, and per-window/per-link
+    #                              drop-prob overrides — all elementwise
+    #                              (tests/test_hlo_census.py bounds the
+    #                              addition; None = the unchanged
+    #                              program, op-count identical).  Ring
+    #                              exchange only.
 
 
 def slot_of(cfg: HashConfig, node: jax.Array, member: jax.Array) -> jax.Array:
@@ -570,16 +583,30 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             f"dynamic_knobs={dynamic_knobs}, budget={cfg.send_budget})")
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
-    use_drop = dynamic_knobs or cfg.drop_prob > 0.0
+    scenario = cfg.scenario
+    use_drop = (dynamic_knobs or cfg.drop_prob > 0.0
+                or (scenario is not None and scenario.has_drop))
     if cfg.telemetry and not ring:
         # make_config gates this (TELEMETRY requires the ring exchange);
         # direct constructors must not silently get an empty timeline.
         raise ValueError("cfg.telemetry requires the ring exchange")
+    if scenario is not None and (not ring or dynamic_knobs
+                                 or cfg.fused_gossip
+                                 or cfg.send_budget > 0):
+        # make_config gates these too (this guards direct constructors):
+        # general scenarios are ring-only, and the per-shift partition/
+        # flake masks are incompatible with the single-payload gossip
+        # kernel, the dynamic-knob sweep step, and the sequential send
+        # budget.
+        raise ValueError(
+            "cfg.scenario requires the plain ring exchange (no "
+            "FUSED_GOSSIP, dynamic knobs, or ENFORCE_BUFFSIZE)")
 
     rng_build = _ring_rng_builder(cfg, use_drop) if ring else None
 
     def step(state: HashState, inputs, fanout=None, drop_prob=None):
-        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        (t, key, start_ticks, fail_mask, fail_time, drop_lo,
+         drop_hi) = inputs[:7]
         fanout_eff = cfg.fanout if fanout is None else fanout
         p_drop = cfg.drop_prob if drop_prob is None else drop_prob
         if ring:
@@ -596,12 +623,61 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # Per-tick coin-drop counts (TELEMETRY scalars only — every
         # append below is guarded, so the off program gains nothing).
         telem_dropped = []
+        # ---- scenario plan activation (scenario/compile.py) ----
+        # Everything here is elementwise math over the small event/
+        # window tensors riding as the 8th scan input; with
+        # cfg.scenario None this whole block (and every site below
+        # that consults it) does not exist in the traced program
+        # (tests/test_hlo_census.py pins op-count identity).
+        if scenario is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                cross_group, cuts_at, site_drop_prob, updown_masks)
+            scn = inputs[7]
+            intro_v = jnp.full((n,), intro, I32)
+            if scenario.has_updown:
+                down_now, up_now = updown_masks(scn, t, idx)
+                fails_now = down_now | up_now
+            else:
+                down_now = up_now = fails_now = None
+            cuts = cuts_at(scn, t, n) if scenario.n_parts else None
+            cuts_prev = (cuts_at(scn, t - 1, n) if scenario.n_parts
+                         else None)
+
+            def site_p(tt, src, dst):
+                p = site_drop_prob(scenario, scn, tt, src, dst)
+                return p
+
+        else:
+            scn = fails_now = None
+
+        def wf_now():
+            """Rows whose pending flushes at t+1 (see _will_flush);
+            under a scenario the legacy single-crash term is replaced
+            by this tick's down/restart transitions."""
+            if fails_now is not None:
+                return recv_mask & ~fails_now
+            return _will_flush(recv_mask, fail_mask, t, fail_time)
+
         if use_drop:
             ctrl_u = (rng.ctrl_u.reshape(2, n) if ring
                       else jax.random.uniform(k_ctrl, (2, n)))
-            ctrl_kept = ~((ctrl_u < p_drop) & drop_active)
+            if scenario is not None:
+                # Per-message effective probs: JOINREQ (idx -> intro)
+                # and JOINREP (intro -> idx); window gating is baked
+                # into the prob, so no drop_active conjunction.
+                p_ctrl = jnp.stack([
+                    jnp.broadcast_to(site_p(t, idx, intro_v), (n,)),
+                    jnp.broadcast_to(site_p(t, intro_v, idx), (n,))])
+                ctrl_kept = ~(ctrl_u < p_ctrl)
+            else:
+                ctrl_kept = ~((ctrl_u < p_drop) & drop_active)
         else:
             ctrl_kept = jnp.ones((2, n), bool)
+        if scenario is not None and scenario.n_parts:
+            # Partition: join control crossing group boundaries is cut
+            # deterministically (no coin).
+            ctrl_kept = ctrl_kept & ~cross_group(cuts, idx,
+                                                 intro_v)[None, :]
 
         # EmulNet bounded-buffer model (ENFORCE_BUFFSIZE): one per-tick
         # global send budget, consumed with drop-on-full per message
@@ -794,8 +870,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                         hb_ack = g2[..., 0]
                         lag_bits = g2[..., 1]
                     elif packed and not cfg.probe_io_none:
-                        will_flush = _will_flush(recv_mask, fail_mask, t,
-                                                 fail_time)
+                        will_flush = wf_now()
                         tbl = _pack_probe_table(vec, will_flush, act)
                         gcat = tbl[jnp.concatenate([id2, tgt1], axis=1)]
                         hb_ack = _gathered_hb(gcat[:, :p_cnt])
@@ -803,14 +878,25 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     else:
                         hb_ack = vec[id2]                  # [N, P] gather
                     valid2 = (ids2 > 0) & (hb_ack > 0)
+                    if scenario is not None and scenario.n_parts:
+                        # The ack traveled target (id2) -> prober (idx)
+                        # during tick t-1: cut it if the partition was
+                        # up then.
+                        valid2 &= ~cross_group(cuts_prev, id2,
+                                               idx[:, None])
                     # Probe-leg drops applied at issue time (probe block
                     # below, one coin shared by both redundant copies, as
                     # in scatter mode); only the ack leg's coin applies
                     # here.
                     if use_drop:
-                        da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                        ack_coin = ((rng.ack_u.reshape(ids2.shape)
-                                     < p_drop) & da_ack)
+                        if scenario is not None:
+                            ack_coin = (rng.ack_u.reshape(ids2.shape)
+                                        < site_p(t - 1, id2,
+                                                 idx[:, None]))
+                        else:
+                            da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                            ack_coin = ((rng.ack_u.reshape(ids2.shape)
+                                         < p_drop) & da_ack)
                         if cfg.telemetry:
                             telem_dropped.append(
                                 (valid2 & ack_coin).sum(dtype=I32))
@@ -931,16 +1017,33 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             else:
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
+                    r = shifts[j]
+                    if scenario is not None and (scenario.n_parts
+                                                 or scenario.n_flakes):
+                        # Shift j sends row i to row (i + r) mod n: the
+                        # cross-group cut and any link-flake override
+                        # are per-SENDER-row vectors — elementwise, no
+                        # gather.
+                        dst_g = jax.lax.rem(idx + r, n)
+                    if scenario is not None and scenario.n_parts:
+                        m = m & ~cross_group(cuts, idx, dst_g)[:, None]
                     if use_drop:
-                        gossip_coin = ((rng.gossip_u[j].reshape(n, s)
-                                        < p_drop) & drop_active)
+                        if scenario is not None:
+                            p_g = site_p(t, idx, dst_g) \
+                                if scenario.n_flakes else site_p(t, 0, 0)
+                            p_gc = (p_g[:, None]
+                                    if getattr(p_g, "ndim", 0) else p_g)
+                            gossip_coin = (rng.gossip_u[j].reshape(n, s)
+                                           < p_gc)
+                        else:
+                            gossip_coin = ((rng.gossip_u[j].reshape(n, s)
+                                            < p_drop) & drop_active)
                         if cfg.telemetry:
                             telem_dropped.append(
                                 (m & gossip_coin).sum(dtype=I32))
                         m = m & ~gossip_coin
                     if track_budget:
                         m, used = _budget_take(m, used)
-                    r = shifts[j]
                     payload = jnp.where(m, view, U32(0))
                     cnt = m.sum(1, dtype=I32)
                     if cfg.shift_set:
@@ -1009,18 +1112,33 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         _, seed_idx = jax.lax.top_k(seeds.astype(I32), min(cfg.seed_cap, n))
         seed_valid = seeds[seed_idx] & seed_burst_on
         burst_valid = seed_valid[:, None] & fresh[intro][None, :]
+        if scenario is not None and scenario.n_parts:
+            # Introducer burst crossing a partition boundary is cut.
+            burst_valid = burst_valid & ~cross_group(
+                cuts, jnp.full_like(seed_idx, intro), seed_idx)[:, None]
         if use_drop:
-            # Ring: the burst coin comes from the plan's k_drop stream
-            # (the ring mode's k_drop_s == k_drop); scatter keeps its
-            # split-off key.
-            dropped = (rng.burst_u.reshape(seed_idx.shape[0], s) < p_drop
-                       if ring else
-                       jax.random.bernoulli(k_drop_s, p_drop,
-                                            (seed_idx.shape[0], s)))
-            if cfg.telemetry:
-                telem_dropped.append(
-                    (burst_valid & dropped & drop_active).sum(dtype=I32))
-            burst_valid = burst_valid & ~(dropped & drop_active)
+            if scenario is not None:
+                p_b = site_p(t, jnp.full_like(seed_idx, intro), seed_idx)
+                p_bc = (p_b[:, None] if getattr(p_b, "ndim", 0) else p_b)
+                dropped = rng.burst_u.reshape(seed_idx.shape[0], s) < p_bc
+                if cfg.telemetry:
+                    telem_dropped.append(
+                        (burst_valid & dropped).sum(dtype=I32))
+                burst_valid = burst_valid & ~dropped
+            else:
+                # Ring: the burst coin comes from the plan's k_drop
+                # stream (the ring mode's k_drop_s == k_drop); scatter
+                # keeps its split-off key.
+                dropped = (rng.burst_u.reshape(seed_idx.shape[0], s)
+                           < p_drop
+                           if ring else
+                           jax.random.bernoulli(k_drop_s, p_drop,
+                                                (seed_idx.shape[0], s)))
+                if cfg.telemetry:
+                    telem_dropped.append(
+                        (burst_valid & dropped
+                         & drop_active).sum(dtype=I32))
+                burst_valid = burst_valid & ~(dropped & drop_active)
         if track_budget:
             # One wire message per burst entry, after the gossip shifts
             # in the consumption order (the reference's introducer sends
@@ -1056,14 +1174,25 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 w_pres = window > 0
                 w_id = ((window - U32(1)) % U32(n)).astype(I32)
                 p_valid = w_pres & (w_id != idx[:, None]) & act[:, None]
+                if scenario is not None and scenario.n_parts:
+                    # A probe to a node across the partition never
+                    # arrives; cut it at issue time (like the drop
+                    # coin), so the ack pipeline and counters only see
+                    # surviving probes.
+                    p_valid = p_valid & ~cross_group(cuts, idx[:, None],
+                                                     w_id)
                 if use_drop:
                     # Probe-leg drop at issue time (drop_active is the
                     # *current* window state, matching the scatter mode's
                     # timing); the dropped probe is never recorded, so
                     # counters and the ack pipeline both see only
                     # surviving probes.
-                    probe_coin = ((rng.probe_u.reshape(p_valid.shape)
-                                   < p_drop) & drop_active)
+                    if scenario is not None:
+                        probe_coin = (rng.probe_u.reshape(p_valid.shape)
+                                      < site_p(t, idx[:, None], w_id))
+                    else:
+                        probe_coin = ((rng.probe_u.reshape(p_valid.shape)
+                                       < p_drop) & drop_active)
                     if cfg.telemetry:
                         telem_dropped.append(
                             (p_valid & probe_coin).sum(dtype=I32))
@@ -1145,8 +1274,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                 # combined gather (probe_bits1) on the default arm; the
                 # split arm gathers its own _pack_probe_bits table.
                 if probe_bits1 is None:
-                    will_flush = _will_flush(recv_mask, fail_mask, t,
-                                             fail_time)
+                    will_flush = wf_now()
                     bits1 = _pack_probe_bits(will_flush, act)[tgt1]
                 else:
                     bits1 = probe_bits1
@@ -1205,7 +1333,31 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
 
         pending_recv = pending_recv + recv_add
 
-        failed = state.failed | (fail_mask & (t == fail_time))
+        if scenario is not None and scenario.has_updown:
+            # Scenario transitions apply at the END of the tick (the
+            # node acts through it — Application::fail timing).  A
+            # restart brings the node back as a FRESH INCARNATION:
+            # state wiped to empty (the receive pass re-seeds the self
+            # slot next tick), heartbeat bumped past anything its old
+            # incarnation ever gossiped so peers' sticky slots refresh.
+            failed = (state.failed | down_now) & ~up_now
+            rcol_r = up_now[:, None]
+            view = jnp.where(rcol_r, U32(0), view)
+            view_ts = jnp.where(rcol_r, 0, view_ts)
+            mail = jnp.where(rcol_r, U32(0), mail)
+            pending_recv = jnp.where(up_now, 0, pending_recv)
+            self_hb = jnp.where(up_now,
+                                jnp.maximum(self_hb, 2 * (t + 1)),
+                                self_hb)
+            if ring and cfg.probes > 0:
+                probe_ids1 = jnp.where(rcol_r, U32(0), probe_ids1)
+                probe_ids2 = jnp.where(rcol_r, U32(0), probe_ids2)
+                act_prev = act_prev & ~up_now
+        elif scenario is not None:
+            failed = state.failed          # partition/flake-only: no
+            #                                up/down machinery compiled
+        else:
+            failed = state.failed | (fail_mask & (t == fail_time))
 
         if cfg.collect_events:
             agg = state.agg
@@ -1230,8 +1382,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                                    (rm_ids != EMPTY).sum(dtype=I32),
                                    sent_tick.sum(dtype=I32),
                                    recv_tick.sum(dtype=I32))
-        wf_prev = (_will_flush(recv_mask, fail_mask, t, fail_time)
-                   if cfg.probe_io_lag else state.wf_prev)
+        wf_prev = wf_now() if cfg.probe_io_lag else state.wf_prev
         new_state = HashState(view, view_ts, started, in_group, failed,
                               self_hb, mail, amail, pmail, joinreq_infl,
                               joinrep_infl, pending_recv, agg,
@@ -1269,7 +1420,7 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
 
 
 def make_config(params: Params, collect_events: bool = True,
-                fail_ids: tuple = ()) -> HashConfig:
+                fail_ids: tuple = (), scenario=None) -> HashConfig:
     n = params.EN_GPSZ
     s = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
     g = params.GOSSIP_LEN if params.GOSSIP_LEN > 0 else s
@@ -1281,6 +1432,29 @@ def make_config(params: Params, collect_events: bool = True,
     qp = n if n <= 1024 else max(128, 32 * params.PROBES)
     seed_cap = n if params.JOIN_MODE == "batch" else SEED_CAP
     exchange = params.resolved_exchange()
+    if scenario is not None:
+        # General-path scenarios (scenario/compile.py) are implemented
+        # on the ring exchange of the hash twins; legacy-shaped
+        # scenarios never reach here (they lower to a plain FailurePlan
+        # and cfg.scenario stays None).
+        if exchange != "ring":
+            raise ValueError(
+                "SCENARIO files with restart/partition/link_flake "
+                "events require the ring exchange on the hash backends "
+                "(EXCHANGE ring / the warm-join auto regime); the "
+                "scatter lowering runs legacy-shaped scenarios only")
+        if params.ENFORCE_BUFFSIZE:
+            raise ValueError(
+                "SCENARIO general events and ENFORCE_BUFFSIZE are "
+                "incompatible (the sequential send budget does not "
+                "model the per-shift partition/flake masks)")
+        if params.FUSED_GOSSIP == 1:
+            raise ValueError(
+                "SCENARIO general events and FUSED_GOSSIP are "
+                "incompatible (the gossip kernels take one pre-masked "
+                "payload; the partition/flake masks are per shift) — "
+                "leave FUSED_GOSSIP on auto, which keeps it off under "
+                "a scenario")
     if params.PROBE_IO == "approx_lag" and exchange != "ring":
         # Loud-rejection policy of the off-path layouts (the sharded and
         # folded guards): on scatter the lag counting branch is
@@ -1342,10 +1516,12 @@ def make_config(params: Params, collect_events: bool = True,
             if fr_knob == -1:
                 fr_knob = int(kernels_ok)
             if fg_knob == -1:
-                # The gossip kernel conflicts with SHIFT_SET (loud gate
-                # below); auto must keep it off rather than resolve into
-                # the error — mirrors the natural-path guard.
-                fg_knob = int(kernels_ok and not params.SHIFT_SET)
+                # The gossip kernel conflicts with SHIFT_SET and with
+                # general scenarios (loud gates); auto must keep it off
+                # rather than resolve into the error — mirrors the
+                # natural-path guard.
+                fg_knob = int(kernels_ok and not params.SHIFT_SET
+                              and scenario is None)
         else:
             if fr_knob == -1:
                 fr_knob = int(
@@ -1357,7 +1533,7 @@ def make_config(params: Params, collect_events: bool = True,
                 # ones the stacked variant — each auto-enables only on
                 # ITS OWN banked hardware family (fail closed).
                 fg_knob = int(
-                    not params.SHIFT_SET
+                    not params.SHIFT_SET and scenario is None
                     and eligible and exchange == "ring"
                     and gossip_fused_supported(n, s)
                     and send_budget_req == 0
@@ -1478,7 +1654,8 @@ def make_config(params: Params, collect_events: bool = True,
                       if exchange == "ring" and params.PROBES > 0
                       and n >= 4 else
                       "split" if n < 4 else "packed"),
-        telemetry=params.TELEMETRY == "scalars")
+        telemetry=params.TELEMETRY == "scalars",
+        scenario=scenario)
 
 
 _RUNNER_CACHE: dict = {}
@@ -1495,13 +1672,16 @@ def _get_runner(cfg: HashConfig, warm: bool):
         step, init = _get_step_and_init(cfg, warm)
 
         def run(keys, ticks, start_ticks, fail_mask, fail_time,
-                drop_lo, drop_hi, warm_key):
+                drop_lo, drop_hi, warm_key, *extra):
+            # *extra carries the scenario tensor plan when cfg.scenario
+            # is set (scenario/compile.ScenarioTensors — scan-invariant
+            # inputs, exactly like the failure schedule).
             state0 = init(warm_key)
 
             def body(state, inp):
                 t, k = inp
                 return step(state, (t, k, start_ticks, fail_mask,
-                                    fail_time, drop_lo, drop_hi))
+                                    fail_time, drop_lo, drop_hi) + extra)
 
             final, ys = jax.lax.scan(body, state0, (ticks, keys))
             telem = None
@@ -1575,17 +1755,23 @@ def _get_segment_runner(cfg: HashConfig, warm: bool):
         hoist = cfg.rng_mode == "hoisted"
         if hoist and cfg.exchange != "ring":
             raise ValueError("RNG_MODE hoisted requires the ring exchange")
-        build = (_ring_rng_builder(cfg, cfg.drop_prob > 0.0) if hoist
-                 else None)
+        # use_drop must match the step's own formula (a scenario with
+        # drop windows/flakes arms the coin streams even when the conf
+        # drop prob is 0) — otherwise the hoisted pre-draw would build a
+        # plan missing the streams the step consumes.
+        seg_use_drop = (cfg.drop_prob > 0.0
+                        or (cfg.scenario is not None
+                            and cfg.scenario.has_drop))
+        build = _ring_rng_builder(cfg, seg_use_drop) if hoist else None
 
         def run_seg(state, ticks, keys, start_ticks, fail_mask, fail_time,
-                    drop_lo, drop_hi):
+                    drop_lo, drop_hi, *extra):
             xs = (ticks, jax.vmap(build)(keys)) if hoist else (ticks, keys)
 
             def body(state, inp):
                 t, k = inp
                 return step(state, (t, k, start_ticks, fail_mask,
-                                    fail_time, drop_lo, drop_hi))
+                                    fail_time, drop_lo, drop_hi) + extra)
 
             return jax.lax.scan(body, state, xs)
 
@@ -1612,7 +1798,11 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     segment boundary on the chunked path, once at the end of a monolithic
     scan.  With telemetry on and no recorder the series is computed and
     dropped (the bench's overhead leg times exactly this)."""
-    cfg = make_config(params, collect_events, fail_ids=plan_fail_ids(plan))
+    scn_prog = getattr(plan, "scenario", None)
+    cfg = make_config(params, collect_events, fail_ids=plan_fail_ids(plan),
+                      scenario=None if scn_prog is None
+                      else scn_prog.static)
+    scn_extra = () if scn_prog is None else (scn_prog.tensors(),)
     total = total_time if total_time is not None else params.TOTAL_TIME
     # Same effective-run-length packing guard as tpu_sparse.run_scan.
     params.validate_sparse_packing(total)
@@ -1657,6 +1847,7 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
             compact_fn=compact_sparse if collect_events else None,
             event_type=None if collect_events else SparseTickEvents,
             finalize=finalize,
+            extra_inputs=scn_extra,
             telemetry_sink=(
                 (telemetry.flush if telemetry is not None
                  else lambda telem, t0: None) if cfg.telemetry else None))
@@ -1667,7 +1858,7 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     run = _get_runner(cfg, warm)
     final_state, events = run(
         keys, ticks, start_ticks, fail_mask, fail_time, drop_lo, drop_hi,
-        make_run_key(params, seed ^ 0x5EED))
+        make_run_key(params, seed ^ 0x5EED), *scn_extra)
     events = jax.tree.map(np.asarray, events)
     if cfg.telemetry:
         events, telem = events
@@ -1682,6 +1873,6 @@ def run_tpu_hash(params: Params, log: Optional[EventLog] = None,
     t0 = _time.time()
     seed = params.SEED if seed is None else seed
     log = log if log is not None else EventLog()
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     return finish_run(params, plan, log, run_scan, t0, seed)

@@ -152,7 +152,7 @@ def _sumP(x, rows, fp, p_cnt):
 def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
                          recv_mask, ack_u, p_drop, use_drop,
                          drop_lo, drop_hi, tbl=None, ids1=None,
-                         count_dropped=False):
+                         count_dropped=False, scn_ctx=None):
     """Ack candidates for probes issued at t-2 (the gather pipeline of
     tpu_hash.make_step ring), on P-folded probe state.  ``vec`` is the
     lagged heartbeat vector ([N]; the sharded caller passes its
@@ -165,7 +165,10 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
     with ``bits1`` the packed filter bits gathered at the t-1 targets
     (None on the split arm) and ``ack_dropped`` the count of candidates
     the ack-leg coin killed (TELEMETRY scalars; None unless
-    ``count_dropped``)."""
+    ``count_dropped``).  ``scn_ctx = (static, scn, cuts_prev, prober)``
+    arms the scenario plan (scenario/compile.py): the partition cut and
+    per-link drop override for the ack's t-1 transit, with ``prober``
+    the P-folded global node ids of the ack receivers."""
     from distributed_membership_tpu.backends.tpu_hash import (
         _gathered_hb, ptr_switch)
     from distributed_membership_tpu.observability.timeline import PHASE_ACK
@@ -181,10 +184,23 @@ def _fold_ack_candidates(n, s, p_cnt, fp, cand_idx, rows, t, ids2, vec,
         else:
             hb_ack = vec[id2]
         valid2 = (ids2 > 0) & (hb_ack > 0)
+        if scn_ctx is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                cross_group)
+            static, scn, cuts_prev, prober = scn_ctx
+            if static.n_parts:
+                valid2 &= ~cross_group(cuts_prev, id2, prober)
         ack_dropped = None
         if use_drop:
-            da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-            ack_coin = (ack_u.reshape(ids2.shape) < p_drop) & da_ack
+            if scn_ctx is not None:
+                from distributed_membership_tpu.scenario.compile import (
+                    site_drop_prob)
+                ack_coin = (ack_u.reshape(ids2.shape)
+                            < site_drop_prob(static, scn, t - 1, id2,
+                                             prober))
+            else:
+                da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                ack_coin = (ack_u.reshape(ids2.shape) < p_drop) & da_ack
             if count_dropped:
                 ack_dropped = (valid2 & ack_coin).sum(dtype=I32)
             valid2 &= ~ack_coin
@@ -226,12 +242,16 @@ def _fold_keep(g, s, fresh, is_self_slot, act, rep, rowsum, thin_u):
 
 def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
                        node_p, probe_u, p_drop, use_drop, drop_active,
-                       count_dropped=False):
+                       count_dropped=False, scn_ctx=None):
     """Issue this tick's probes from the cyclic window (P-folded).
     ``probe_u`` is the planned issue-time drop uniform (flat; None when
     drops are off).  Returns (ids_new [rows/FP, 128] u32, p_valid bool,
     probe_dropped) — the last the issue-leg coin-kill count (TELEMETRY
-    scalars; None unless ``count_dropped``)."""
+    scalars; None unless ``count_dropped``).
+    ``scn_ctx = (static, scn, cuts)`` arms the scenario plan: probes to
+    targets across the active partition are cut at issue time, and the
+    drop coin takes the per-link effective probability (``node_p`` must
+    then carry GLOBAL node ids)."""
     from distributed_membership_tpu.backends.tpu_hash import ptr_switch
     from distributed_membership_tpu.observability.timeline import (
         PHASE_PROBE)
@@ -244,10 +264,23 @@ def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
         w_pres = window > 0
         w_id = ((window - U32(1)) % U32(n)).astype(I32)
         p_valid = w_pres & (w_id != node_p) & _repP(act, rows, fp, p_cnt)
+        if scn_ctx is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                cross_group)
+            static, scn, cuts = scn_ctx
+            if static.n_parts:
+                p_valid = p_valid & ~cross_group(cuts, node_p, w_id)
         probe_dropped = None
         if use_drop:
-            probe_coin = ((probe_u.reshape(p_valid.shape) < p_drop)
-                          & drop_active)
+            if scn_ctx is not None:
+                from distributed_membership_tpu.scenario.compile import (
+                    site_drop_prob)
+                probe_coin = (probe_u.reshape(p_valid.shape)
+                              < site_drop_prob(static, scn, t, node_p,
+                                               w_id))
+            else:
+                probe_coin = ((probe_u.reshape(p_valid.shape) < p_drop)
+                              & drop_active)
             if count_dropped:
                 probe_dropped = (p_valid & probe_coin).sum(dtype=I32)
             p_valid = p_valid & ~probe_coin
@@ -269,7 +302,9 @@ def make_folded_step(cfg):
     f = LANES // s
     nf = n // f
     k_max = min(cfg.fanout, s)
-    use_drop = cfg.drop_prob > 0.0
+    scenario = cfg.scenario
+    use_drop = cfg.drop_prob > 0.0 or (scenario is not None
+                                       and scenario.has_drop)
     p_red = 1 if cfg.qp >= n else 2
     cstride = STRIDE % s
     single_col_roll = (n * STRIDE) % s == 0
@@ -318,15 +353,42 @@ def make_folded_step(cfg):
     packed = cfg.probe_gather == "packed" and n >= 4
 
     def step(state, inputs):
-        t, key, start_ticks, fail_mask, fail_time, drop_lo, drop_hi = inputs
+        (t, key, start_ticks, fail_mask, fail_time, drop_lo,
+         drop_hi) = inputs[:7]
         from distributed_membership_tpu.ops.rng_plan import RingRng
         rng = key if isinstance(key, RingRng) else rng_build(key)
         p_drop = cfg.drop_prob
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
+        # ---- scenario plan activation (tpu_hash.make_step's twin on
+        # folded planes: same per-node quantities, rep()'d — the fold
+        # contract keeps the two trajectories bit-exact) ----
+        if scenario is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                base_drop_prob, cross_group, cuts_at, site_drop_prob,
+                updown_masks)
+            scn = inputs[7]
+            if scenario.has_updown:
+                down_now, up_now = updown_masks(scn, t, idx)
+                fails_now = down_now | up_now
+            else:
+                down_now = up_now = fails_now = None
+            cuts = cuts_at(scn, t, n) if scenario.n_parts else None
+            cuts_prev = (cuts_at(scn, t - 1, n) if scenario.n_parts
+                         else None)
+        else:
+            scn = fails_now = None
+
         recv_mask = state.started & (t > start_ticks) & ~state.failed
         rcol = rep(recv_mask)
         telem_dropped = []      # TELEMETRY scalars only (guarded below)
+
+        def wf_now():
+            if fails_now is not None:
+                return recv_mask & ~fails_now
+            from distributed_membership_tpu.backends.tpu_hash import (
+                _will_flush)
+            return _will_flush(recv_mask, fail_mask, t, fail_time)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -346,12 +408,11 @@ def make_folded_step(cfg):
         will_flush = bits1 = None
         if p_cnt > 0:
             from distributed_membership_tpu.backends.tpu_hash import (
-                _pack_probe_table, _will_flush)
+                _pack_probe_table)
             vec = jnp.where(state.act_prev, state.self_hb - 1, 0)
             tbl = ids1_for_tbl = None
             if packed and not cfg.probe_io_none:
-                will_flush = _will_flush(recv_mask, fail_mask, t,
-                                         fail_time)
+                will_flush = wf_now()
                 tbl = _pack_probe_table(vec, will_flush, act)
                 ids1_for_tbl = state.probe_ids1
             cand_sf, ack_recv_cnt, bits1, ack_dropped = \
@@ -359,7 +420,9 @@ def make_folded_step(cfg):
                     n, s, p_cnt, fp, cand_idx, n, t, state.probe_ids2,
                     vec, recv_mask, rng.ack_u if use_drop else None,
                     p_drop, use_drop, drop_lo, drop_hi, tbl=tbl,
-                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry)
+                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts_prev, node_p)))
             if cfg.telemetry and ack_dropped is not None:
                 telem_dropped.append(ack_dropped)
 
@@ -421,14 +484,27 @@ def make_folded_step(cfg):
         stacked = []      # (payload, r, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
+            r = shifts[jshift]
+            if scenario is not None and (scenario.n_parts
+                                         or scenario.n_flakes):
+                dst_g = jax.lax.rem(idx + r, n)          # [N] per sender
+            if scenario is not None and scenario.n_parts:
+                m = m & ~rep(cross_group(cuts, idx, dst_g))
             if use_drop:
-                gossip_coin = ((rng.gossip_u[jshift].reshape(nf, LANES)
-                                < p_drop) & drop_active)
+                if scenario is not None:
+                    p_g = (site_drop_prob(scenario, scn, t, idx, dst_g)
+                           if scenario.n_flakes
+                           else base_drop_prob(scn, t))
+                    p_ge = rep(p_g) if getattr(p_g, "ndim", 0) else p_g
+                    gossip_coin = (rng.gossip_u[jshift].reshape(nf, LANES)
+                                   < p_ge)
+                else:
+                    gossip_coin = ((rng.gossip_u[jshift].reshape(nf, LANES)
+                                    < p_drop) & drop_active)
                 if cfg.telemetry:
                     telem_dropped.append(
                         (m & gossip_coin).sum(dtype=I32))
                 m = m & ~gossip_coin
-            r = shifts[jshift]
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
             sent_gossip = sent_gossip + cnt
@@ -473,7 +549,9 @@ def make_folded_step(cfg):
             ids_new, p_valid, probe_dropped = _fold_probe_window(
                 n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
                 rng.probe_u if use_drop else None, p_drop, use_drop,
-                drop_active, count_dropped=cfg.telemetry)
+                drop_active, count_dropped=cfg.telemetry,
+                scn_ctx=(None if scenario is None else
+                         (scenario, scn, cuts)))
             if cfg.telemetry and probe_dropped is not None:
                 telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
@@ -512,10 +590,9 @@ def make_folded_step(cfg):
                 # (bits1); the split arm gathers its own bit table.
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs, _gathered_act, _gathered_flush,
-                    _pack_probe_bits, _will_flush)
+                    _pack_probe_bits)
                 if bits1 is None:
-                    will_flush = _will_flush(recv_mask, fail_mask, t,
-                                             fail_time)
+                    will_flush = wf_now()
                     packed_g = _pack_probe_bits(will_flush, act)[tgt1]
                 else:
                     packed_g = bits1
@@ -528,7 +605,27 @@ def make_folded_step(cfg):
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
         pending_recv = pending_recv + recv_add
-        failed = state.failed | (fail_mask & (t == fail_time))
+        if scenario is not None and scenario.has_updown:
+            # Scenario up/down transitions at end of tick — the folded
+            # twin of tpu_hash.make_step's reset block (rep()'d planes).
+            failed = (state.failed | down_now) & ~up_now
+            up_e = rep(up_now)
+            view = jnp.where(up_e, U32(0), view)
+            view_ts = jnp.where(up_e, 0, view_ts)
+            mail = jnp.where(up_e, U32(0), mail)
+            pending_recv = jnp.where(up_now, 0, pending_recv)
+            self_hb = jnp.where(up_now,
+                                jnp.maximum(self_hb, 2 * (t + 1)),
+                                self_hb)
+            if p_cnt > 0:
+                up_p = _repP(up_now, n, fp, p_cnt)
+                probe_ids1 = jnp.where(up_p, U32(0), probe_ids1)
+                probe_ids2 = jnp.where(up_p, U32(0), probe_ids2)
+                act_prev = act_prev & ~up_now
+        elif scenario is not None:
+            failed = state.failed
+        else:
+            failed = state.failed | (fail_mask & (t == fail_time))
 
         agg = update_fast_agg(
             state.agg, t=t, fail_ids=cfg.fail_ids,
@@ -597,7 +694,9 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
     f = LANES // s
     lf = n_local // f
     k_max = min(cfg.fanout, s)
-    use_drop = cfg.drop_prob > 0.0
+    scenario = cfg.scenario
+    use_drop = cfg.drop_prob > 0.0 or (scenario is not None
+                                       and scenario.has_drop)
     p_red = 1 if cfg.qp >= n else 2
     cstride = STRIDE % s
     single_col_roll = (n_local * STRIDE) % s == 0
@@ -642,8 +741,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
     seed_rows = min(cfg.seed_cap, n)
 
     def step(state, inputs):
-        t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = \
-            inputs
+        (t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo,
+         drop_hi) = inputs[:7]
         me = lax.axis_index(AX)
         row0 = (me * n_local).astype(I32)
         lrows = row0 + l_idx
@@ -660,9 +759,35 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             cold_join=False, batched=cfg.rng_mode != "scattered")
         drop_active = (t > drop_lo) & (t <= drop_hi)
 
+        # ---- scenario plan activation (local rows; the tensors are
+        # replicated inputs, so every shard computes its slice
+        # elementwise — no collectives added) ----
+        if scenario is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                base_drop_prob, cross_group, cuts_at, site_drop_prob,
+                updown_masks)
+            scn = inputs[7]
+            if scenario.has_updown:
+                down_now, up_now = updown_masks(scn, t, lrows)
+                fails_now = down_now | up_now
+            else:
+                down_now = up_now = fails_now = None
+            cuts = cuts_at(scn, t, n) if scenario.n_parts else None
+            cuts_prev = (cuts_at(scn, t - 1, n) if scenario.n_parts
+                         else None)
+        else:
+            scn = fails_now = None
+
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = rep(recv_mask)
         telem_dropped = []      # TELEMETRY scalars only (guarded below)
+
+        def wf_now():
+            if fails_now is not None:
+                return recv_mask & ~fails_now
+            from distributed_membership_tpu.backends.tpu_hash import (
+                _will_flush)
+            return _will_flush(recv_mask, fail_mask_l, t, fail_time)
 
         recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
@@ -685,12 +810,11 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         will_flush_l = will_flush_g = bits1 = None
         if p_cnt > 0:
             from distributed_membership_tpu.backends.tpu_hash import (
-                _gathered_flush, _pack_probe_table, _will_flush)
+                _gathered_flush, _pack_probe_table)
             vec_l = jnp.where(state.act_prev, state.self_hb - 1, 0)
             tbl = ids1_for_tbl = None
             if packed and not cfg.probe_io_none:
-                will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                           fail_time)
+                will_flush_l = wf_now()
                 tbl = lax.all_gather(
                     _pack_probe_table(vec_l, will_flush_l, act), AX,
                     tiled=True)                             # ONE [N] wire
@@ -705,7 +829,10 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                     state.probe_ids2, vec_g, recv_mask,
                     rng.ack_u if use_drop else None, cfg.drop_prob,
                     use_drop, drop_lo, drop_hi, tbl=tbl,
-                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry)
+                    ids1=ids1_for_tbl, count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts_prev,
+                              local_node_p + row0)))
             if cfg.telemetry and ack_dropped is not None:
                 telem_dropped.append(ack_dropped)
 
@@ -731,9 +858,23 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
+            u = shifts[jshift]
+            if scenario is not None and (scenario.n_parts
+                                         or scenario.n_flakes):
+                dst_g = lax.rem(lrows + u, n)        # [L] per sender row
+            if scenario is not None and scenario.n_parts:
+                m = m & ~rep(cross_group(cuts, lrows, dst_g))
             if use_drop:
-                gossip_coin = ((rng.gossip_u[jshift].reshape(lf, LANES)
-                                < cfg.drop_prob) & drop_active)
+                if scenario is not None:
+                    p_g = (site_drop_prob(scenario, scn, t, lrows, dst_g)
+                           if scenario.n_flakes
+                           else base_drop_prob(scn, t))
+                    p_ge = rep(p_g) if getattr(p_g, "ndim", 0) else p_g
+                    gossip_coin = (rng.gossip_u[jshift].reshape(lf, LANES)
+                                   < p_ge)
+                else:
+                    gossip_coin = ((rng.gossip_u[jshift].reshape(lf, LANES)
+                                    < cfg.drop_prob) & drop_active)
                 if cfg.telemetry:
                     telem_dropped.append(
                         (m & gossip_coin).sum(dtype=I32))
@@ -741,7 +882,6 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             payload = jnp.where(m, view, U32(0))
             cnt = rowsum(m.astype(I32))
             sent_gossip = sent_gossip + cnt
-            u = shifts[jshift]
             b = u // n_local
             c = lax.rem(u, n_local)
             payload_r, cnt_r = block_send((payload, cnt), b)
@@ -791,7 +931,9 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 n, s, p_cnt, fp, window_idx, n_local, t, view, act,
                 local_node_p + row0, rng.probe_u if use_drop else None,
                 cfg.drop_prob, use_drop, drop_active,
-                count_dropped=cfg.telemetry)
+                count_dropped=cfg.telemetry,
+                scn_ctx=(None if scenario is None else
+                         (scenario, scn, cuts)))
             if cfg.telemetry and probe_dropped is not None:
                 telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
@@ -837,12 +979,11 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             else:
                 from distributed_membership_tpu.backends.tpu_hash import (
                     _credit_orphan_recvs_sharded, _gathered_act,
-                    _gathered_flush, _pack_probe_bits, _will_flush)
+                    _gathered_flush, _pack_probe_bits)
                 if bits1 is None:
                     # split arm: three separate all_gathers + a bit-table
                     # gather (the pre-round-6 lowering).
-                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                               fail_time)
+                    will_flush_l = wf_now()
                     will_flush_g = lax.all_gather(
                         will_flush_l, AX, tiled=True)        # [N]
                     act_g = lax.all_gather(act, AX, tiled=True)  # [N]
@@ -863,7 +1004,25 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
         pending_recv = pending_recv + recv_add
-        failed = state.failed | (fail_mask_l & (t == fail_time))
+        if scenario is not None and scenario.has_updown:
+            failed = (state.failed | down_now) & ~up_now
+            up_e = rep(up_now)
+            view = jnp.where(up_e, U32(0), view)
+            view_ts = jnp.where(up_e, 0, view_ts)
+            mail = jnp.where(up_e, U32(0), mail)
+            pending_recv = jnp.where(up_now, 0, pending_recv)
+            self_hb = jnp.where(up_now,
+                                jnp.maximum(self_hb, 2 * (t + 1)),
+                                self_hb)
+            if p_cnt > 0:
+                up_p = _repP(up_now, n_local, fp, p_cnt)
+                probe_ids1 = jnp.where(up_p, U32(0), probe_ids1)
+                probe_ids2 = jnp.where(up_p, U32(0), probe_ids2)
+                act_prev = act_prev & ~up_now
+        elif scenario is not None:
+            failed = state.failed
+        else:
+            failed = state.failed | (fail_mask_l & (t == fail_time))
 
         agg = update_fast_agg(
             state.agg, t=t, fail_ids=cfg.fail_ids,

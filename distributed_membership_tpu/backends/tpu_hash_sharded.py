@@ -84,7 +84,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import EMPTY, hash_slot
 from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, make_plan, make_run_key, plan_tensors)
+    FailurePlan, make_run_key, plan_tensors, resolve_plan)
 
 INTRO = INTRODUCER_INDEX
 
@@ -342,12 +342,23 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
     n, s, g = cfg.n, cfg.s, cfg.g
     k_max = min(cfg.fanout, s)
     l_idx = jnp.arange(n_local, dtype=I32)
-    use_drop = cfg.drop_prob > 0.0
+    scenario = cfg.scenario
+    use_drop = cfg.drop_prob > 0.0 or (scenario is not None
+                                       and scenario.has_drop)
     p_red = 1 if cfg.qp >= n else 2
     cstride = STRIDE % s
     if cfg.probes >= s:
         raise ValueError("ring mode needs PROBES < VIEW_SIZE "
                          f"(got {cfg.probes} >= {s})")
+    if scenario is not None and cold_join:
+        # The cold-join control plane (replicated JOINREQ/JOINREP +
+        # seed bursts) predates the scenario engine; scale scenarios
+        # run warm.  Loud gate rather than silently un-partitioned
+        # join traffic.
+        raise ValueError(
+            "SCENARIO general events on tpu_hash_sharded require "
+            "JOIN_MODE warm (the cold-join control plane does not "
+            "model partitions/flakes)")
     # AX feeds every whole-axis collective; a tuple of axis names has the
     # flattened-mesh semantics (outer-major), so the protocol below is
     # mesh-shape-agnostic — only block_send decomposes per axis.
@@ -360,7 +371,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
     seed_rows = min(cfg.seed_cap, n)
 
     def step(state: ShardedHashState, inputs):
-        t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo, drop_hi = inputs
+        (t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo,
+         drop_hi) = inputs[:7]
         me = lax.axis_index(AX)
         row0 = (me * n_local).astype(I32)
         lrows = row0 + l_idx
@@ -378,11 +390,36 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         telem_dropped = []      # LOCAL counts (psum'd at emission);
         #                         TELEMETRY scalars only — guarded below.
 
+        # ---- scenario plan activation (scenario/compile.py): local
+        # rows against replicated event/window tensors — elementwise,
+        # no collectives added.  cfg.scenario None => this block and
+        # every consulting site below do not exist in the program.
+        if scenario is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                base_drop_prob, cross_group, cuts_at, site_drop_prob,
+                updown_masks)
+            scn = inputs[7]
+            if scenario.has_updown:
+                down_now, up_now = updown_masks(scn, t, lrows)
+                fails_now = down_now | up_now
+            else:
+                down_now = up_now = fails_now = None
+            cuts = cuts_at(scn, t, n) if scenario.n_parts else None
+            cuts_prev = (cuts_at(scn, t - 1, n) if scenario.n_parts
+                         else None)
+        else:
+            scn = fails_now = None
+
         # ---- receive: admit + ack + self + sweep as one fused pass ----
         # (ops/fused_receive: receive_core, or its Pallas twin when
         # cfg.fused_receive — identical math, tpu_hash.make_step ring.)
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
         rcol = recv_mask[:, None]
+
+        def wf_now():
+            if fails_now is not None:
+                return recv_mask & ~fails_now
+            return _will_flush(recv_mask, fail_mask_l, t, fail_time)
 
         # ---- join handshake control plane (cold_join only) ----
         # Replicated computation throughout: the introducer's receive/act
@@ -474,8 +511,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             tgt1 = jnp.clip(ids1.astype(I32) - 1, 0)   # global target ids
             with jax.named_scope(PHASE_ACK):
                 if packed_gather and not cfg.probe_io_none:
-                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                               fail_time)
+                    will_flush_l = wf_now()
                     tbl_g = lax.all_gather(
                         _pack_probe_table(vec_l, will_flush_l, act), AX,
                         tiled=True)                      # ONE [N] wire
@@ -487,10 +523,21 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                     vec_g = lax.all_gather(vec_l, AX, tiled=True)    # [N]
                     hb_ack = vec_g[id2]
                 valid2 = (ids2 > 0) & (hb_ack > 0)
+                if scenario is not None and scenario.n_parts:
+                    # Ack traveled target (id2) -> prober (lrows) during
+                    # tick t-1: cut if the partition was up then.
+                    valid2 &= ~cross_group(cuts_prev, id2,
+                                           lrows[:, None])
                 if use_drop:
-                    da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
-                    ack_coin = ((rng.ack_u.reshape(ids2.shape)
-                                 < cfg.drop_prob) & da_ack)
+                    if scenario is not None:
+                        ack_coin = (rng.ack_u.reshape(ids2.shape)
+                                    < site_drop_prob(
+                                        scenario, scn, t - 1, id2,
+                                        lrows[:, None]))
+                    else:
+                        da_ack = (t - 1 > drop_lo) & (t - 1 <= drop_hi)
+                        ack_coin = ((rng.ack_u.reshape(ids2.shape)
+                                     < cfg.drop_prob) & da_ack)
                     if cfg.telemetry:
                         telem_dropped.append(
                             (valid2 & ack_coin).sum(dtype=I32))
@@ -566,9 +613,27 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
         for j in range(k_max):
             m = keep & (j < k_eff)[:, None]
+            u = shifts[j]
+            if scenario is not None and (scenario.n_parts
+                                         or scenario.n_flakes):
+                # Shift u sends global row i to (i + u) mod n: the
+                # partition cut and flake override are per-sender-row
+                # vectors on the local slice — elementwise, no gather.
+                dst_g = lax.rem(lrows + u, n)
+            if scenario is not None and scenario.n_parts:
+                m = m & ~cross_group(cuts, lrows, dst_g)[:, None]
             if use_drop:
-                gossip_coin = ((rng.gossip_u[j].reshape(n_local, s)
-                                < cfg.drop_prob) & drop_active)
+                if scenario is not None:
+                    p_g = (site_drop_prob(scenario, scn, t, lrows, dst_g)
+                           if scenario.n_flakes
+                           else base_drop_prob(scn, t))
+                    p_gc = (p_g[:, None]
+                            if getattr(p_g, "ndim", 0) else p_g)
+                    gossip_coin = (rng.gossip_u[j].reshape(n_local, s)
+                                   < p_gc)
+                else:
+                    gossip_coin = ((rng.gossip_u[j].reshape(n_local, s)
+                                    < cfg.drop_prob) & drop_active)
                 if cfg.telemetry:
                     telem_dropped.append(
                         (m & gossip_coin).sum(dtype=I32))
@@ -576,7 +641,6 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             payload = jnp.where(m, view, U32(0))
             cnt = m.sum(1, dtype=I32)
             sent_gossip = sent_gossip + cnt
-            u = shifts[j]
             b = u // n_local
             c = lax.rem(u, n_local)
             payload_r, cnt_r = block_send((payload, cnt), b)
@@ -682,9 +746,21 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 w_pres = window > 0
                 w_id = ((window - U32(1)) % U32(n)).astype(I32)
                 p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
+                if scenario is not None and scenario.n_parts:
+                    # Cross-partition probes cut at issue time (as the
+                    # drop coin), so counters and the ack pipeline see
+                    # only surviving probes.
+                    p_valid = p_valid & ~cross_group(
+                        cuts, lrows[:, None], w_id)
                 if use_drop:
-                    probe_coin = ((rng.probe_u.reshape(p_valid.shape)
-                                   < cfg.drop_prob) & drop_active)
+                    if scenario is not None:
+                        probe_coin = (rng.probe_u.reshape(p_valid.shape)
+                                      < site_drop_prob(
+                                          scenario, scn, t,
+                                          lrows[:, None], w_id))
+                    else:
+                        probe_coin = ((rng.probe_u.reshape(p_valid.shape)
+                                       < cfg.drop_prob) & drop_active)
                     if cfg.telemetry:
                         telem_dropped.append(
                             (p_valid & probe_coin).sum(dtype=I32))
@@ -734,8 +810,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 if probe_bits1 is None:
                     # split arm: three separate all_gathers + its own
                     # per-target bit gather (pre-round-6 lowering).
-                    will_flush_l = _will_flush(recv_mask, fail_mask_l, t,
-                                               fail_time)
+                    will_flush_l = wf_now()
                     will_flush_g = lax.all_gather(
                         will_flush_l, AX, tiled=True)        # [N]
                     act_g = lax.all_gather(act, AX, tiled=True)     # [N]
@@ -756,7 +831,27 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             recv_add = recv_add + recv_probe + ack_recv_cnt
 
         pending_recv = pending_recv + recv_add
-        failed = state.failed | (fail_mask_l & (t == fail_time))
+        if scenario is not None and scenario.has_updown:
+            # Scenario up/down transitions at end of tick; a restart
+            # wipes the node's local rows to a fresh incarnation
+            # (tpu_hash.make_step's reset block on the local slice).
+            failed = (state.failed | down_now) & ~up_now
+            rcol_r = up_now[:, None]
+            view = jnp.where(rcol_r, U32(0), view)
+            view_ts = jnp.where(rcol_r, 0, view_ts)
+            mail = jnp.where(rcol_r, U32(0), mail)
+            pending_recv = jnp.where(up_now, 0, pending_recv)
+            self_hb = jnp.where(up_now,
+                                jnp.maximum(self_hb, 2 * (t + 1)),
+                                self_hb)
+            if cfg.probes > 0:
+                probe_ids1 = jnp.where(rcol_r, U32(0), probe_ids1)
+                probe_ids2 = jnp.where(rcol_r, U32(0), probe_ids2)
+                act_prev = act_prev & ~up_now
+        elif scenario is not None:
+            failed = state.failed
+        else:
+            failed = state.failed | (fail_mask_l & (t == fail_time))
 
         if cfg.collect_events:
             agg = state.agg
@@ -1254,14 +1349,19 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
         step, init, state_spec, out_spec, AX = _build_step(
             cfg, n_local, mesh, warm)
 
-        def whole_run(keys, ticks, start_ticks, fail_mask_g, fail_time,
-                      drop_lo, drop_hi, warm_key):
+        def whole_run(*args):
+            # Trailing arg beyond the 8 fixed ones is the scenario
+            # tensor plan (replicated — every shard slices its rows
+            # elementwise).
+            (keys, ticks, start_ticks, fail_mask_g, fail_time,
+             drop_lo, drop_hi, warm_key) = args[:8]
+            extra = args[8:]
             state0 = init(warm_key)
 
             def body(state, inp):
                 t, k = inp
                 return step(state, (t, k, start_ticks, fail_mask_g,
-                                    fail_time, drop_lo, drop_hi))
+                                    fail_time, drop_lo, drop_hi) + extra)
 
             final_state, out = lax.scan(body, state0, (ticks, keys))
             if not cfg.collect_events:
@@ -1270,9 +1370,10 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
                         final_state.agg, ax=AX))
             return final_state, out
 
+        n_in = 9 if cfg.scenario is not None else 8
         sharded = shard_map(
             whole_run, mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(),) * n_in,
             out_specs=(state_spec, out_spec),
             check_vma=False,
         )
@@ -1320,8 +1421,10 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
         step, _, state_spec, out_spec, AX = _build_step(
             cfg, n_local, mesh, warm)
 
-        def seg_run(state, ticks, keys, start_ticks, fail_mask_g,
-                    fail_time, drop_lo, drop_hi):
+        def seg_run(state, *args):
+            (ticks, keys, start_ticks, fail_mask_g, fail_time,
+             drop_lo, drop_hi) = args[:7]
+            extra = args[7:]            # scenario tensor plan, if any
             if not cfg.collect_events:
                 # The incoming agg is the accumulated global value (shape
                 # ≠ the per-shard partials); start this segment's
@@ -1333,7 +1436,7 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
             def body(state, inp):
                 t, k = inp
                 return step(state, (t, k, start_ticks, fail_mask_g,
-                                    fail_time, drop_lo, drop_hi))
+                                    fail_time, drop_lo, drop_hi) + extra)
 
             final_state, out = lax.scan(body, state, (ticks, keys))
             if not cfg.collect_events:
@@ -1342,9 +1445,10 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
                         final_state.agg, ax=AX))
             return final_state, out
 
+        n_in = 8 if cfg.scenario is not None else 7
         sharded = shard_map(
             seg_run, mesh=mesh,
-            in_specs=(state_spec, P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(state_spec,) + (P(),) * n_in,
             out_specs=(state_spec, out_spec),
             check_vma=False,
         )
@@ -1361,7 +1465,11 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
         raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
     n_local = n // d
     fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
-    cfg = make_config(params, collect_events, fail_ids=fail_ids)
+    scn_prog = getattr(plan, "scenario", None)
+    cfg = make_config(params, collect_events, fail_ids=fail_ids,
+                      scenario=None if scn_prog is None
+                      else scn_prog.static)
+    scn_extra = () if scn_prog is None else (scn_prog.tensors(),)
     if cfg.probe_io_lag:
         raise ValueError(
             "PROBE_IO approx_lag is single-chip tpu_hash only (the "
@@ -1468,6 +1576,7 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
             segment_fn=segment_fn, collect_events=collect_events,
             compact_fn=compact_sparse if collect_events else None,
             event_type=None if collect_events else SparseTickEvents,
+            extra_inputs=scn_extra,
             telemetry_sink=(
                 (telemetry.flush if telemetry is not None
                  else lambda telem, t0: None) if cfg.telemetry else None))
@@ -1478,7 +1587,8 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     run = _get_runner(cfg, n_local, mesh, warm)
     final_state, events = run(keys, ticks, start_ticks, fail_mask,
                               fail_time, drop_lo, drop_hi,
-                              make_run_key(params, seed ^ 0x5EED))
+                              make_run_key(params, seed ^ 0x5EED),
+                              *scn_extra)
     events = jax.tree.map(np.asarray, events)
     if cfg.telemetry:
         events, telem = events
@@ -1494,7 +1604,7 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
     t0 = _time.time()
     seed = params.SEED if seed is None else seed
     log = log if log is not None else EventLog()
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     if mesh is None:
         if params.MESH_SHAPE:

@@ -53,7 +53,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.parallel.collectives import (
     all_gather_vec, reduce_scatter_sum, ring_reduce_scatter_max)
 from distributed_membership_tpu.parallel.mesh import NODE_AXIS, make_mesh
-from distributed_membership_tpu.runtime.failures import make_plan, plan_tensors
+from distributed_membership_tpu.runtime.failures import plan_tensors, resolve_plan
 
 INTRO = INTRODUCER_INDEX
 
@@ -309,7 +309,7 @@ def run_tpu_sharded(params: Params, log: Optional[EventLog] = None,
     t0 = _time.time()
     seed = params.SEED if seed is None else seed
     log = log if log is not None else EventLog()
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     if mesh is None:
         # Largest device count that divides N (grader N=10 on 8 devices → 5).

@@ -72,7 +72,7 @@ from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import (
     EMPTY, merge_views, scatter_mailbox, unpack_mailbox)
 from distributed_membership_tpu.runtime.failures import (
-    FailurePlan, log_failures, make_plan, make_run_key, plan_tensors)
+    FailurePlan, log_failures, make_run_key, plan_tensors, resolve_plan)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -627,6 +627,28 @@ def finish_run(params: Params, plan: FailurePlan, log: EventLog,
         sent = np.asarray(events.sent).T
         recv = np.asarray(events.recv).T
         extra = {"final_state": final_state}
+    scn_prog = getattr(plan, "scenario", None)
+    if scn_prog is not None:
+        # Scenario oracle (scenario/oracle.py): grade the run against
+        # its declared chaos schedule from whatever this run recorded —
+        # telemetry series > dbg.log events — plus the final carry.
+        from distributed_membership_tpu.scenario.oracle import (
+            scenario_report)
+        report = scenario_report(
+            scn_prog, params, final_state=final_state,
+            summary=extra.get("detection_summary"),
+            timeline=(recorder.series() if recorder is not None
+                      else None),
+            dbg_text=(log.dbg_text() if not aggregate else None))
+        extra["scenario_report"] = report
+        if params.TELEMETRY_DIR:
+            # Next to timeline.jsonl/summary.json so run_report.py can
+            # render scenario markers and cross-check oracle totals
+            # against the telemetry counters.
+            import json as _json
+            with open(os.path.join(params.TELEMETRY_DIR,
+                                   "scenario.json"), "w") as fh:
+                _json.dump(report, fh, indent=1)
     if recorder is not None:
         extra["timeline"] = recorder.series()
         extra["timeline_path"] = recorder.path
@@ -650,6 +672,6 @@ def run_tpu_sparse(params: Params, log: Optional[EventLog] = None,
     t0 = _time.time()
     seed = params.SEED if seed is None else seed
     log = log if log is not None else EventLog()
-    plan = make_plan(params, _pyrandom.Random(f"app:{seed}"))
+    plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
     return finish_run(params, plan, log, run_scan, t0, seed)

@@ -256,6 +256,16 @@ class Params:
     # TELEMETRY).  '' = keep telemetry in memory only (the series still
     # lands in RunResult.extra['timeline']).
     TELEMETRY_DIR: str = ""
+    # Declarative chaos schedule (scenario/ package): path to a scenario
+    # JSON describing timed events — crash / restart / leave / partition
+    # / link_flake / drop_window — compiled to in-scan tensor plans
+    # (scenario/compile.py).  Legacy-shaped scenarios (crashes at one
+    # time + at most one global drop window) lower to the unchanged
+    # FailurePlan path and run on EVERY backend; general scenarios
+    # (restarts, partitions, flaky links) run on emul and the ring
+    # twins (tpu_hash incl. FOLDED, tpu_hash_sharded) and are rejected
+    # loudly elsewhere at plan-resolution time.  '' = off.
+    SCENARIO: str = ""
     # 1 = resume from CHECKPOINT_DIR's latest valid checkpoint when one
     # exists (manifest validated against this config/seed — a mismatch
     # raises instead of silently computing a different run); when none

@@ -27,7 +27,8 @@ def run_conf(conf_path: str, backend: str | None = None,
              checkpoint_dir: str | None = None,
              resume: bool | None = None,
              telemetry: str | None = None,
-             telemetry_dir: str | None = None) -> RunResult:
+             telemetry_dir: str | None = None,
+             scenario: str | None = None) -> RunResult:
     # Validation runs AFTER the CLI overrides merge: cross-field rules
     # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
     # effective config, not the conf file alone.
@@ -49,6 +50,10 @@ def run_conf(conf_path: str, backend: str | None = None,
         params.TELEMETRY = telemetry
     if telemetry_dir is not None:
         params.TELEMETRY_DIR = telemetry_dir
+    # Scenario engine (scenario/ package): --scenario wins over the
+    # conf's SCENARIO key, same precedence as every knob above.
+    if scenario is not None:
+        params.SCENARIO = scenario
     params.validate()
     result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
     result.log.flush(out_dir)
@@ -193,6 +198,11 @@ def main(argv=None) -> int:
                     help="TELEMETRY_DIR conf key: directory for "
                          "timeline.jsonl / runlog.jsonl / summary.json "
                          "(render with scripts/run_report.py)")
+    ap.add_argument("--scenario", default=None, metavar="FILE",
+                    help="SCENARIO conf key: a declarative chaos-schedule "
+                         "JSON (crash/restart/leave/partition/link_flake/"
+                         "drop_window events — scenario/ package; examples "
+                         "in scenarios/ at the repo root)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                     help="pin the jax platform (e.g. cpu for hermetic runs on "
                          "a virtual device mesh)")
@@ -221,7 +231,8 @@ def main(argv=None) -> int:
                       checkpoint_dir=args.checkpoint_dir,
                       resume=args.resume,
                       telemetry=args.telemetry,
-                      telemetry_dir=args.telemetry_dir)
+                      telemetry_dir=args.telemetry_dir,
+                      scenario=args.scenario)
 
     summary = {
         "backend": result.params.BACKEND,
@@ -236,6 +247,8 @@ def main(argv=None) -> int:
     }
     if "detection_summary" in result.extra:
         summary["detection"] = result.extra["detection_summary"]
+    if "scenario_report" in result.extra:
+        summary["scenario"] = result.extra["scenario_report"]
     if result.extra.get("timeline_path"):
         summary["timeline_path"] = result.extra["timeline_path"]
     if args.grade:

@@ -181,7 +181,7 @@ def _atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
 
 def _manifest_base(params: Params, seed: int, total: int,
                    collect_events: bool) -> dict:
-    return {
+    base = {
         "version": CKPT_VERSION,
         "params_text": params_identity(params),
         "seed": int(seed),
@@ -189,6 +189,17 @@ def _manifest_base(params: Params, seed: int, total: int,
         "total_time": int(total),
         "collect_events": bool(collect_events),
     }
+    if params.SCENARIO:
+        # Content digest, not just the path (already in params_text): a
+        # silently edited schedule must fail the resume validation, not
+        # resume into a different chaos plan.
+        from distributed_membership_tpu.scenario.compile import (
+            scenario_digest)
+        try:
+            base["scenario_digest"] = scenario_digest(params.SCENARIO)
+        except OSError:
+            base["scenario_digest"] = "unreadable"
+    return base
 
 
 def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
@@ -301,7 +312,7 @@ def _crash_tick() -> Optional[int]:
 def chunked_run(params: Params, plan, seed: int, total: int, *,
                 init_carry, segment_fn, collect_events: bool,
                 compact_fn=None, event_type=None, finalize=None,
-                telemetry_sink=None):
+                telemetry_sink=None, extra_inputs=()):
     """Run the tick loop in ``CHECKPOINT_EVERY``-tick segments.
 
     ``init_carry()`` builds the fresh device carry; ``segment_fn(carry,
@@ -316,6 +327,14 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
     complete) — the chunked home of run-total epilogues that ride the
     monolithic scan's tail on the unchunked path (tpu_hash's
     PROBE_IO approx_lag counter correction).
+
+    ``extra_inputs`` is a tuple of additional scan-invariant inputs
+    appended to every ``segment_fn`` call after the failure schedule —
+    the scenario engine's tensor plan rides here
+    (scenario/compile.ScenarioTensors).  Nothing scenario-shaped enters
+    the carry or the snapshots: the plan is re-derived from the
+    scenario file on resume, and the manifest pins the file's content
+    digest so an edited schedule cannot silently resume.
 
     ``telemetry_sink(telem, t0)``, when given, marks the backend's
     per-tick outputs as the pair ``(events, TickTelemetry-of-[K]-series)``
@@ -424,7 +443,7 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
             t_seg = time.perf_counter()
             carry, ev = segment_fn(carry, ticks[a:b], keys[a:b],
                                    start_ticks, fail_mask, fail_time,
-                                   drop_lo, drop_hi)
+                                   drop_lo, drop_hi, *extra_inputs)
             # Per-segment flush: events leave the device NOW, so full-mode
             # device memory is O(every * N * M), and the carry lands on
             # host for the snapshot.

@@ -25,10 +25,46 @@ from distributed_membership_tpu.config import Params
 @dataclasses.dataclass
 class FailurePlan:
     kind: str                    # 'single' | 'multi' | 'racks' | 'none'
+    #                              | 'scenario' (general scenario path)
     fail_time: Optional[int]
     failed_indices: List[int]    # node indices (0-based) crashed at fail_time
+    #                              (general scenarios: the PERMANENTLY
+    #                              failed set, fail_time = earliest crash)
     drop_start: Optional[int]    # tick when dropmsg flips on (None if never)
     drop_stop: Optional[int]
+    # Compiled general-path scenario (scenario/compile.ScenarioProgram),
+    # None for legacy plans and legacy-shaped scenarios.  Threading it on
+    # the plan lets the scenario subsystem ride every existing
+    # (params, plan, seed) seam — finish_run, chunked_run, run_scan —
+    # without new plumbing.
+    scenario: Optional[object] = None
+
+
+def draw_single(n: int, rng: random.Random) -> int:
+    """Application.cpp:182: removed = rand() % EN_GPSZ."""
+    return rng.randrange(n)
+
+
+def draw_multi(n: int, rng: random.Random):
+    """Application.cpp:189: removed = rand() % EN_GPSZ / 2 (C precedence:
+    (rand() % N) / 2), then the N/2 contiguous nodes from there fail.
+    Returns the [lo, hi) range."""
+    start = rng.randrange(n) // 2
+    return start, min(start + n // 2, n)
+
+
+def draw_racks(params: Params, rng: random.Random) -> List[int]:
+    """Correlated rack failures: RACK_FAILURES distinct racks of
+    RACK_SIZE contiguous nodes (the scale-scenario extension)."""
+    n = params.EN_GPSZ
+    n_racks = max(n // params.RACK_SIZE, 1)
+    racks = rng.sample(range(n_racks), min(params.RACK_FAILURES, n_racks))
+    return sorted(
+        i
+        for r in racks
+        for i in range(r * params.RACK_SIZE,
+                       min((r + 1) * params.RACK_SIZE, n))
+    )
 
 
 def make_plan(params: Params, rng: random.Random) -> FailurePlan:
@@ -37,31 +73,30 @@ def make_plan(params: Params, rng: random.Random) -> FailurePlan:
     drop_stop = params.DROP_STOP if params.DROP_MSG else None
 
     if params.RACK_SIZE > 0 and params.RACK_FAILURES > 0:
-        # Correlated rack failures: RACK_FAILURES distinct racks of RACK_SIZE
-        # contiguous nodes all crash at FAIL_TIME.
-        n_racks = max(n // params.RACK_SIZE, 1)
-        racks = rng.sample(range(n_racks), min(params.RACK_FAILURES, n_racks))
-        failed = [
-            i
-            for r in racks
-            for i in range(r * params.RACK_SIZE,
-                           min((r + 1) * params.RACK_SIZE, n))
-        ]
-        return FailurePlan("racks", params.FAIL_TIME, sorted(failed),
-                           drop_start, drop_stop)
+        return FailurePlan("racks", params.FAIL_TIME,
+                           draw_racks(params, rng), drop_start, drop_stop)
 
     if params.SINGLE_FAILURE:
-        # Application.cpp:182: removed = rand() % EN_GPSZ.
-        failed = [rng.randrange(n)]
-        return FailurePlan("single", params.FAIL_TIME, failed,
-                           drop_start, drop_stop)
+        return FailurePlan("single", params.FAIL_TIME,
+                           [draw_single(n, rng)], drop_start, drop_stop)
 
-    # Application.cpp:189: removed = rand() % EN_GPSZ / 2 (C precedence:
-    # (rand() % N) / 2), then the N/2 contiguous nodes from there fail.
-    start = rng.randrange(n) // 2
-    failed = list(range(start, min(start + n // 2, n)))
-    return FailurePlan("multi", params.FAIL_TIME, failed,
+    lo, hi = draw_multi(n, rng)
+    return FailurePlan("multi", params.FAIL_TIME, list(range(lo, hi)),
                        drop_start, drop_stop)
+
+
+def resolve_plan(params: Params, rng: random.Random) -> FailurePlan:
+    """The failure schedule for a run: the legacy seeded draw, or — when
+    ``SCENARIO:`` names a schedule file — the compiled scenario
+    (scenario/compile.py).  Legacy-shaped scenarios lower to a plain
+    FailurePlan (and may set the params drop-window keys), so every
+    backend runs them through the unchanged legacy code; general
+    scenarios attach ``plan.scenario`` for the tensor-plan path."""
+    if params.SCENARIO:
+        from distributed_membership_tpu.scenario.compile import (
+            resolve_scenario_plan)
+        return resolve_scenario_plan(params, rng)
+    return make_plan(params, rng)
 
 
 def make_run_key(params: Params, seed: int):

@@ -1,0 +1,33 @@
+"""Scenario engine: declarative chaos schedules compiled to in-scan
+tensor plans.
+
+The reference injects exactly one failure shape — a crash at ``FAIL_TIME``
+plus a single global drop window (runtime/failures.py, Application.cpp:
+173-202).  This package generalizes that into a declarative scenario
+subsystem:
+
+  * :mod:`schema` — a small JSON schema of timed events (``crash``,
+    ``restart``, ``leave``, ``partition``, ``link_flake``,
+    ``drop_window``) with range/list/draw node selectors;
+  * :mod:`compile` — lowers a scenario into tick-indexed tensor plans
+    (:class:`~compile.ScenarioTensors`) that ride the jitted ring steps
+    of all four ring twins (tpu_hash natural/folded, tpu_hash_sharded
+    natural/folded) as scan inputs — composing with CHECKPOINT_EVERY /
+    RESUME bit-exactly — plus a host twin (:class:`~compile.ScenarioHost`)
+    for the reference ``emul`` backend.  Scenarios expressible in legacy
+    terms (crashes at one time + at most one global drop window) lower
+    straight to a :class:`~runtime.failures.FailurePlan`, so they
+    reproduce ``make_plan`` bit-exactly on EVERY backend;
+  * :mod:`oracle` — the scenario oracle: false-positive removals during
+    partitions, re-convergence tick after heal, rejoin completion per
+    restart event — rendered through the run_report pipeline.
+
+Select with the ``SCENARIO:`` conf key / ``--scenario`` CLI flag; example
+schedules live in ``scenarios/`` at the repo root (README "Scenarios").
+"""
+
+from distributed_membership_tpu.scenario.schema import (  # noqa: F401
+    Scenario, load_scenario, validate_scenario)
+from distributed_membership_tpu.scenario.compile import (  # noqa: F401
+    ScenarioHost, ScenarioProgram, ScenarioStatic, ScenarioTensors,
+    compile_scenario, resolve_scenario_plan)

@@ -1,0 +1,453 @@
+"""Scenario compiler: events → tick-indexed tensor plans.
+
+The compiler has two lowerings:
+
+  * **Legacy** — a scenario whose events are crashes at ONE time plus at
+    most one global drop window is exactly the failure shape the
+    reference injects, so it lowers straight to a
+    :class:`~distributed_membership_tpu.runtime.failures.FailurePlan`
+    (draw selectors consume the same seeded RNG stream ``make_plan``
+    does — the shipped ``scenarios/*.json`` testcase twins reproduce
+    ``make_plan`` bit-exactly on EVERY backend, pinned in
+    tests/test_scenario.py).
+  * **General** — anything with restart/leave/partition/link_flake or
+    multi-time crashes compiles to a :class:`ScenarioProgram` carrying
+    :class:`ScenarioTensors`: small time/range tensors that ride the
+    jitted ring steps as scan INPUTS (like the failure schedule), so the
+    per-tick activation is pure elementwise math on ``t`` — no [N, T]
+    materialization, no new gathers (tests/test_hlo_census.py bounds the
+    addition), and checkpoint/resume composes for free (the tensors are
+    re-derived from the scenario file; nothing scenario-shaped enters
+    the carry).
+
+Shape conventions (every array padded to length >= 1 with inert rows so
+the jitted program's structure depends only on :class:`ScenarioStatic`,
+which rides ``HashConfig`` as the runner-cache key):
+
+  * windows are active for ``start < t <= stop`` — the legacy
+    DROP_START/DROP_STOP convention (``(t > lo) & (t <= hi)``);
+  * partition groups lower to boundary cuts (``part_cut``, padded with
+    N), so the send-path predicate is ``group[src] != group[dst]`` with
+    ``group(x) = sum(x >= cuts)`` — elementwise, gather-free;
+  * probabilities are pre-quantized to integer percent (schema note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from distributed_membership_tpu.scenario.schema import (
+    Scenario, load_scenario, validate_scenario)
+
+DOWN_KINDS = ("crash", "leave")
+
+# Backends implementing the general tensor-plan path.  Everything else
+# accepts only legacy-shaped scenarios (which lower to a FailurePlan and
+# run the unchanged code).  The jitted twins additionally require the
+# ring exchange (tpu_hash.make_config gates it).
+GENERAL_BACKENDS = ("emul", "tpu_hash", "tpu_hash_sharded")
+
+
+class ScenarioStatic(NamedTuple):
+    """Hashable structural descriptor — everything that changes the
+    traced program (tensor shapes + which code blocks exist).  Rides
+    ``HashConfig.scenario`` so runner caches key on it."""
+    n: int
+    n_events: int         # point-event rows (crash/leave/restart ranges)
+    n_parts: int          # partition windows
+    n_cuts: int           # group-boundary cut columns
+    n_flakes: int         # link_flake windows
+    n_windows: int        # global drop windows
+    has_drop: bool        # any coin-consuming loss (windows or flakes)
+    has_updown: bool      # any crash/leave/restart event
+
+
+class ScenarioTensors(NamedTuple):
+    """The in-scan plan (all jnp arrays; shapes per ScenarioStatic)."""
+    ev_time: object       # [E] i32 (pad -9: never fires)
+    ev_down: object       # [E] bool — crash | leave rows
+    ev_up: object         # [E] bool — restart rows
+    ev_lo: object         # [E] i32
+    ev_hi: object         # [E] i32
+    part_start: object    # [P] i32 (pad -9)
+    part_stop: object     # [P] i32 (pad -9)
+    part_cut: object      # [P, C] i32 (pad N — group 0 everywhere)
+    fl_start: object      # [F] i32 (pad -9)
+    fl_stop: object       # [F] i32
+    fl_slo: object        # [F] i32
+    fl_shi: object        # [F] i32
+    fl_dlo: object        # [F] i32
+    fl_dhi: object        # [F] i32
+    fl_prob: object       # [F] f32 (quantized)
+    dw_lo: object         # [W] i32 (pad -9)
+    dw_hi: object         # [W] i32
+    dw_prob: object       # [W] f32 (quantized)
+
+
+def _quant(p: float) -> float:
+    """Integer-percent quantization (EmulNet.cpp:92 semantics), applied
+    once at compile so every backend drops identically."""
+    return int(float(p) * 100) / 100.0
+
+
+# ---------------------------------------------------------------------------
+# In-step helpers (pure jnp; called inside the jitted ring steps)
+
+def updown_masks(scn: ScenarioTensors, t, node_ids):
+    """(down_now, up_now) bool masks shaped like ``node_ids`` — which
+    nodes crash/leave resp. restart at the end of tick ``t``.  Pure
+    elementwise broadcast over the [E] event rows."""
+    hit = scn.ev_time == t                                  # [E]
+    x = node_ids[..., None]
+    in_rng = (x >= scn.ev_lo) & (x < scn.ev_hi)             # [..., E]
+    down = (in_rng & (hit & scn.ev_down)).any(-1)
+    up = (in_rng & (hit & scn.ev_up)).any(-1)
+    return down, up
+
+
+def cuts_at(scn: ScenarioTensors, t, n: int):
+    """The active partition's [C] group-boundary cuts at tick ``t`` (all
+    N — i.e. "one group" — when no partition is active; windows never
+    overlap, schema.validate_scenario)."""
+    import jax.numpy as jnp
+
+    act = (t > scn.part_start) & (t <= scn.part_stop)       # [P]
+    return jnp.where(act[:, None], scn.part_cut, n).min(0)  # [C]
+
+
+def cross_group(cuts, src, dst):
+    """``group[src] != group[dst]`` under the cut row — the partition
+    send-path predicate (elementwise; broadcastable src/dst)."""
+    import jax.numpy as jnp
+
+    def grp(x):
+        return (x[..., None] >= cuts).sum(-1)
+    return grp(src) != grp(dst)
+
+
+def base_drop_prob(scn: ScenarioTensors, t):
+    """Scalar f32: the max active global drop-window probability at t."""
+    import jax.numpy as jnp
+
+    act = (t > scn.dw_lo) & (t <= scn.dw_hi)
+    return jnp.where(act, scn.dw_prob, 0.0).max()
+
+
+def site_drop_prob(static: ScenarioStatic, scn: ScenarioTensors, t,
+                   src, dst):
+    """Per-message effective drop probability for a send site: the
+    active global window combined with any matching link-flake window as
+    independent loss (``p + q - p*q``; exactly ``p`` where no flake
+    matches, so flake-free runs stay bit-identical to the window-only
+    form).  Returns a scalar when the scenario has no flakes, else a
+    tensor broadcast over ``src``/``dst``."""
+    import jax.numpy as jnp
+
+    p = base_drop_prob(scn, t)
+    if static.n_flakes == 0:
+        return p
+    act = (t > scn.fl_start) & (t <= scn.fl_stop)           # [F]
+    s = src[..., None] if hasattr(src, "ndim") else jnp.asarray(src)[..., None]
+    d = dst[..., None] if hasattr(dst, "ndim") else jnp.asarray(dst)[..., None]
+    m = act & (s >= scn.fl_slo) & (s < scn.fl_shi) \
+        & (d >= scn.fl_dlo) & (d < scn.fl_dhi)
+    q = jnp.where(m, scn.fl_prob, 0.0).max(-1)
+    return p + q - p * q
+
+
+# ---------------------------------------------------------------------------
+# Compiled program
+
+@dataclasses.dataclass
+class ScenarioProgram:
+    """A compiled general-path scenario: the resolved event list plus
+    the tensor-plan builder.  Attached to the run's ``FailurePlan``
+    (``plan.scenario``) so it threads through the existing backend
+    entrypoints unchanged."""
+    scenario: Scenario
+    n: int
+    static: ScenarioStatic
+    point_events: List[dict]      # {kind, time, ranges: [(lo, hi)...]}
+    partitions: List[dict]        # {start, stop, cuts: [..]}
+    flakes: List[dict]            # {start, stop, src, dst, drop_prob}
+    drop_windows: List[dict]      # {start, stop, drop_prob}
+
+    _tensors: Optional[ScenarioTensors] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def tensors(self) -> ScenarioTensors:
+        """The jnp tensor plan (built once per program)."""
+        if self._tensors is None:
+            import jax.numpy as jnp
+            np_t = self.numpy_tensors()
+            self._tensors = ScenarioTensors(
+                *(jnp.asarray(a) for a in np_t))
+        return self._tensors
+
+    def numpy_tensors(self) -> ScenarioTensors:
+        st = self.static
+        e = max(st.n_events, 1)
+        ev_time = np.full((e,), -9, np.int32)
+        ev_down = np.zeros((e,), bool)
+        ev_up = np.zeros((e,), bool)
+        ev_lo = np.zeros((e,), np.int32)
+        ev_hi = np.zeros((e,), np.int32)
+        i = 0
+        for ev in self.point_events:
+            for lo, hi in ev["ranges"]:
+                ev_time[i] = ev["time"]
+                ev_down[i] = ev["kind"] in DOWN_KINDS
+                ev_up[i] = ev["kind"] == "restart"
+                ev_lo[i], ev_hi[i] = lo, hi
+                i += 1
+        p = max(st.n_parts, 1)
+        c = max(st.n_cuts, 1)
+        part_start = np.full((p,), -9, np.int32)
+        part_stop = np.full((p,), -9, np.int32)
+        part_cut = np.full((p, c), self.n, np.int32)
+        for j, w in enumerate(self.partitions):
+            part_start[j], part_stop[j] = w["start"], w["stop"]
+            part_cut[j, :len(w["cuts"])] = w["cuts"]
+        f = max(st.n_flakes, 1)
+        fl = {k: np.full((f,), -9, np.int32)
+              for k in ("start", "stop")}
+        fl.update({k: np.zeros((f,), np.int32)
+                   for k in ("slo", "shi", "dlo", "dhi")})
+        fl_prob = np.zeros((f,), np.float32)
+        for j, w in enumerate(self.flakes):
+            fl["start"][j], fl["stop"][j] = w["start"], w["stop"]
+            fl["slo"][j], fl["shi"][j] = w["src"]
+            fl["dlo"][j], fl["dhi"][j] = w["dst"]
+            fl_prob[j] = w["drop_prob"]
+        wn = max(st.n_windows, 1)
+        dw_lo = np.full((wn,), -9, np.int32)
+        dw_hi = np.full((wn,), -9, np.int32)
+        dw_prob = np.zeros((wn,), np.float32)
+        for j, w in enumerate(self.drop_windows):
+            dw_lo[j], dw_hi[j] = w["start"], w["stop"]
+            dw_prob[j] = w["drop_prob"]
+        return ScenarioTensors(
+            ev_time, ev_down, ev_up, ev_lo, ev_hi,
+            part_start, part_stop, part_cut,
+            fl["start"], fl["stop"], fl["slo"], fl["shi"], fl["dlo"],
+            fl["dhi"], fl_prob, dw_lo, dw_hi, dw_prob)
+
+    def host(self) -> "ScenarioHost":
+        return ScenarioHost(self)
+
+
+class ScenarioHost:
+    """Host-side twin of the tensor plan for the ``emul`` backend's
+    queue-level network: the same window/partition/flake semantics
+    evaluated per message in numpy/python."""
+
+    def __init__(self, program: ScenarioProgram):
+        self.program = program
+        t = program.numpy_tensors()
+        self._t = t
+        self.n = program.n
+
+    def down_at(self, t: int) -> List[int]:
+        return self._fire(t, self._t.ev_down)
+
+    def up_at(self, t: int) -> List[int]:
+        return self._fire(t, self._t.ev_up)
+
+    def _fire(self, t: int, kind_mask) -> List[int]:
+        out: List[int] = []
+        tt = self._t
+        for j in range(len(tt.ev_time)):
+            if tt.ev_time[j] == t and kind_mask[j]:
+                out.extend(range(int(tt.ev_lo[j]), int(tt.ev_hi[j])))
+        return sorted(set(out))
+
+    def _cuts(self, t: int):
+        tt = self._t
+        act = (t > tt.part_start) & (t <= tt.part_stop)
+        return np.where(act[:, None], tt.part_cut, self.n).min(0)
+
+    def blocked(self, t: int, src: int, dst: int) -> bool:
+        if self.program.static.n_parts == 0:
+            return False
+        cuts = self._cuts(t)
+        return int((src >= cuts).sum()) != int((dst >= cuts).sum())
+
+    def drop_pct(self, t: int, src: int, dst: int) -> int:
+        """Effective drop percentage for one message (reference-style
+        integer percent; see site_drop_prob for the combine)."""
+        tt = self._t
+        act = (t > tt.dw_lo) & (t <= tt.dw_hi)
+        p = float(np.where(act, tt.dw_prob, 0.0).max())
+        q = 0.0
+        if self.program.static.n_flakes:
+            m = ((t > tt.fl_start) & (t <= tt.fl_stop)
+                 & (src >= tt.fl_slo) & (src < tt.fl_shi)
+                 & (dst >= tt.fl_dlo) & (dst < tt.fl_dhi))
+            q = float(np.where(m, tt.fl_prob, 0.0).max())
+        return int((p + q - p * q) * 100)
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+
+def _resolve_ranges(ev: dict, params, rng) -> Tuple[List[Tuple[int, int]],
+                                                    str]:
+    """→ (ranges, plan_kind_hint) for one point event; draw selectors
+    consume ``rng`` exactly as the legacy planner does
+    (runtime/failures.draw_*), so a draw-based scenario is bit-exact
+    with make_plan for the same seed."""
+    from distributed_membership_tpu.runtime.failures import (
+        draw_multi, draw_racks, draw_single)
+
+    if "range" in ev:
+        lo, hi = ev["range"]
+        return [(int(lo), int(hi))], "multi"
+    if "nodes" in ev:
+        return [(int(i), int(i) + 1) for i in sorted(set(ev["nodes"]))], \
+            "multi"
+    draw = ev["draw"]
+    if draw == "single":
+        idx = draw_single(params.EN_GPSZ, rng)
+        return [(idx, idx + 1)], "single"
+    if draw == "multi":
+        lo, hi = draw_multi(params.EN_GPSZ, rng)
+        return ([(lo, hi)] if hi > lo else []), "multi"
+    indices = draw_racks(params, rng)
+    return [(i, i + 1) for i in indices], "racks"
+
+
+def _indices(ranges: List[Tuple[int, int]]) -> List[int]:
+    return sorted({i for lo, hi in ranges for i in range(lo, hi)})
+
+
+def scenario_digest(path: str) -> str:
+    """sha256 of the scenario file bytes — the checkpoint manifest's
+    provenance field (a changed schedule must not silently resume)."""
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def compile_scenario(scn: Scenario, params, rng):
+    """→ a FailurePlan, with ``plan.scenario`` set to the
+    :class:`ScenarioProgram` on the general path and ``None`` on the
+    legacy lowering (where ``params`` may be mutated to carry the
+    scenario's drop window through the unchanged legacy code).
+    """
+    from distributed_membership_tpu.runtime.failures import FailurePlan
+
+    n, total = params.EN_GPSZ, params.TOTAL_TIME
+    validate_scenario(scn, n, total)
+
+    point, parts, flakes, windows = [], [], [], []
+    kind_hint = "multi"
+    for ev in scn.events:
+        kind = ev["kind"]
+        if kind in ("crash", "restart", "leave"):
+            ranges, hint = _resolve_ranges(ev, params, rng)
+            if kind == "crash":
+                kind_hint = hint
+            point.append({"kind": kind, "time": int(ev["time"]),
+                          "ranges": ranges})
+        elif kind == "partition":
+            parts.append({"start": int(ev["start"]),
+                          "stop": int(ev["stop"]),
+                          "cuts": [int(g[0]) for g in ev["groups"][1:]]})
+        elif kind == "link_flake":
+            flakes.append({"start": int(ev["start"]),
+                           "stop": int(ev["stop"]),
+                           "src": (int(ev["src"][0]), int(ev["src"][1])),
+                           "dst": (int(ev["dst"][0]), int(ev["dst"][1])),
+                           "drop_prob": _quant(ev["drop_prob"])})
+        else:
+            windows.append({"start": int(ev["start"]),
+                            "stop": int(ev["stop"]),
+                            "drop_prob": _quant(ev["drop_prob"])})
+
+    crashes = [e for e in point if e["kind"] in DOWN_KINDS]
+    crash_times = sorted({e["time"] for e in crashes})
+    restarts = [e for e in point if e["kind"] == "restart"]
+
+    # A conf-level drop window coexists with a scenario window only when
+    # they are the SAME window (then the legacy lowering still applies —
+    # the shipped msgdrop twin names the window its conf already has);
+    # different windows compose on the general path.
+    conf_window_ok = (not windows or not params.DROP_MSG or (
+        len(windows) == 1
+        and windows[0]["start"] == params.DROP_START
+        and windows[0]["stop"] == params.DROP_STOP
+        and windows[0]["drop_prob"] == params.effective_drop_prob()))
+    legacy_shape = (
+        not parts and not flakes and not restarts
+        and all(e["kind"] == "crash" for e in point)
+        and len(crash_times) <= 1 and len(windows) <= 1
+        and conf_window_ok)
+    if legacy_shape:
+        if windows and not params.DROP_MSG:
+            w = windows[0]
+            params.DROP_MSG = 1
+            params.MSG_DROP_PROB = w["drop_prob"]
+            params.DROP_START = w["start"]
+            params.DROP_STOP = w["stop"]
+        drop_start = params.DROP_START if params.DROP_MSG else None
+        drop_stop = params.DROP_STOP if params.DROP_MSG else None
+        fail_time = crash_times[0] if crash_times else None
+        failed = _indices([r for e in crashes for r in e["ranges"]])
+        return FailurePlan(kind_hint if failed else "none",
+                           fail_time if failed else None, failed,
+                           drop_start, drop_stop)
+
+    if params.BACKEND not in GENERAL_BACKENDS:
+        raise ValueError(
+            f"scenario {scn.name!r} needs the general tensor-plan path "
+            f"(restart/partition/link_flake/multi-time events), which "
+            f"BACKEND {params.BACKEND!r} does not implement "
+            f"(supported: {GENERAL_BACKENDS}; legacy-shaped scenarios — "
+            "crashes at one time + one drop window — run everywhere)")
+
+    # Conf-level drop window composes as one more global window.
+    if params.DROP_MSG:
+        windows.append({"start": params.DROP_START,
+                        "stop": params.DROP_STOP,
+                        "drop_prob": params.effective_drop_prob()})
+
+    # Permanent failures: last down transition not followed by a restart
+    # covering the node.  These seed the detection-oracle id set
+    # (fail_ids / detection_summary); restart-churned nodes are live at
+    # the end and their removals are the oracle's churn events.
+    last_down: dict = {}
+    last_up: dict = {}
+    for e in point:
+        for i in _indices(e["ranges"]):
+            if e["kind"] in DOWN_KINDS:
+                last_down[i] = max(last_down.get(i, -1), e["time"])
+            else:
+                last_up[i] = max(last_up.get(i, -1), e["time"])
+    perm_set = {i for i, td in last_down.items()
+                if td > last_up.get(i, -1)}
+    permanent = sorted(perm_set)
+    fail_time = (min(e["time"] for e in crashes
+                     if perm_set.intersection(_indices(e["ranges"])))
+                 if permanent else None)
+
+    n_events = sum(len(e["ranges"]) for e in point)
+    static = ScenarioStatic(
+        n=n, n_events=n_events, n_parts=len(parts),
+        n_cuts=max((len(p["cuts"]) for p in parts), default=0),
+        n_flakes=len(flakes), n_windows=len(windows),
+        has_drop=bool(windows or flakes), has_updown=n_events > 0)
+    program = ScenarioProgram(
+        scenario=scn, n=n, static=static, point_events=point,
+        partitions=parts, flakes=flakes, drop_windows=windows)
+    return FailurePlan("scenario", fail_time, permanent, None, None,
+                       scenario=program)
+
+
+def resolve_scenario_plan(params, rng):
+    """Load ``params.SCENARIO`` and compile it (the ``resolve_plan``
+    hook in runtime/failures.py)."""
+    scn = load_scenario(params.SCENARIO)
+    return compile_scenario(scn, params, rng)

@@ -1,0 +1,202 @@
+"""Scenario schema: declarative timed chaos events.
+
+A scenario file is JSON::
+
+    {
+      "name": "partition_heal",
+      "events": [
+        {"kind": "partition", "start": 60, "stop": 120,
+         "groups": [[0, 1024], [1024, 2048]]},
+        {"kind": "crash",   "time": 30, "range": [4, 8]},
+        {"kind": "restart", "time": 90, "range": [4, 8]},
+        {"kind": "leave",   "time": 50, "nodes": [17]},
+        {"kind": "link_flake", "start": 100, "stop": 200,
+         "src": [0, 1024], "dst": [1024, 2048], "drop_prob": 0.2},
+        {"kind": "drop_window", "start": 50, "stop": 300,
+         "drop_prob": 0.1}
+      ]
+    }
+
+Event kinds:
+
+  * ``crash`` / ``leave`` — the selected nodes go down at the END of tick
+    ``time`` (they act through it, exactly like the reference's
+    ``Application::fail`` timing).  ``leave`` is mechanically identical in
+    the simulator (the reference protocol has no LEAVE message); the
+    oracle classifies its removals as expected departures, not failures.
+  * ``restart`` — the selected nodes come back at the end of ``time``
+    with a FRESH INCARNATION: state wiped to a self-only view, heartbeat
+    bumped to ``2*(time+1)`` so it strictly dominates any stale gossip of
+    the pre-crash incarnation (heartbeats advance +2 per live tick, so
+    this is the value an uninterrupted peer would be near).  The rejoin
+    is warm — neighbors re-admit the id through normal gossip; the
+    introducer handshake is not re-run (it does not exist in the
+    JOIN_MODE=warm scale regime the ring twins target).
+  * ``partition`` — for ``start < t <= stop`` (the legacy drop-window
+    convention), messages crossing group boundaries are dropped
+    deterministically.  ``groups`` must be disjoint contiguous index
+    ranges in ascending order tiling ``[0, N)``; the compiler lowers them
+    to boundary cuts so the send-path predicate is the elementwise
+    ``group[src] != group[dst]`` — no per-message gather.  At most one
+    partition window may be active at any tick.
+  * ``link_flake`` — for ``start < t <= stop``, messages from
+    ``src`` range to ``dst`` range (directed) take an EXTRA drop
+    probability ``drop_prob``; it combines with any active global window
+    as independent loss (``p + q - p*q``) on the same per-message coin.
+  * ``drop_window`` — a global Bernoulli drop window, the generalization
+    of the legacy DROP_MSG/[DROP_START, DROP_STOP) injection; multiple
+    windows may be given (the max of the active probabilities applies).
+
+Node selectors for crash/restart/leave (exactly one per event):
+
+  * ``"range": [lo, hi]`` — indices ``lo <= i < hi``;
+  * ``"nodes": [i, ...]`` — an explicit list (compiled to unit ranges);
+  * ``"draw": "single" | "multi" | "racks"`` — defer to the seeded
+    failure draw the legacy planner makes (runtime/failures.py
+    draw_single/draw_multi/draw_racks), so a scenario file can replay the
+    shipped testcases bit-exactly without hardcoding the seed-dependent
+    victim.
+
+Probabilities are quantized to integer percent at compile time
+(``int(p * 100) / 100``), matching the reference's EmulNet.cpp:92
+comparison so every backend drops identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List
+
+EVENT_KINDS = ("crash", "restart", "leave", "partition", "link_flake",
+               "drop_window")
+DRAW_KINDS = ("single", "multi", "racks")
+_POINT_KINDS = ("crash", "restart", "leave")
+
+
+@dataclasses.dataclass
+class Scenario:
+    """A parsed (but not yet compiled) scenario."""
+    name: str
+    events: List[dict]
+    source: str = ""          # file path, for provenance/manifests
+
+    @classmethod
+    def from_dict(cls, d: dict, source: str = "") -> "Scenario":
+        if not isinstance(d, dict) or "events" not in d:
+            raise ValueError(
+                f"scenario {source or '<dict>'}: expected an object with "
+                "an 'events' list")
+        events = d["events"]
+        if not isinstance(events, list) or not events:
+            raise ValueError(
+                f"scenario {source or '<dict>'}: 'events' must be a "
+                "non-empty list")
+        return cls(name=str(d.get("name", "unnamed")),
+                   events=[dict(e) for e in events], source=source)
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as fh:
+        try:
+            d = json.load(fh)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"scenario {path!r}: invalid JSON ({e})") from e
+    return Scenario.from_dict(d, source=path)
+
+
+def _check_range(ev: dict, key: str, n: int, what: str) -> None:
+    r = ev.get(key)
+    if (not isinstance(r, (list, tuple)) or len(r) != 2
+            or not all(isinstance(x, int) for x in r)
+            or not 0 <= r[0] < r[1] <= n):
+        raise ValueError(
+            f"scenario event {ev}: {what} {key!r} must be [lo, hi] with "
+            f"0 <= lo < hi <= N={n}")
+
+
+def validate_scenario(scn: Scenario, n: int, total: int) -> None:
+    """Structural validation against a concrete (N, TOTAL_TIME).
+
+    Raises ``ValueError`` on the first violation — a scenario typo must
+    fail at config time, never silently simulate something else.
+    """
+    part_spans = []
+    for ev in scn.events:
+        kind = ev.get("kind")
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"scenario {scn.name!r}: unknown event kind {kind!r} "
+                f"(known: {EVENT_KINDS})")
+        if kind in _POINT_KINDS:
+            t = ev.get("time")
+            if not isinstance(t, int) or not 0 <= t < total:
+                raise ValueError(
+                    f"scenario event {ev}: 'time' must be an int in "
+                    f"[0, TOTAL_TIME={total})")
+            sels = [k for k in ("range", "nodes", "draw") if k in ev]
+            if len(sels) != 1:
+                raise ValueError(
+                    f"scenario event {ev}: exactly one of range/nodes/"
+                    "draw is required")
+            if "range" in ev:
+                _check_range(ev, "range", n, kind)
+            elif "nodes" in ev:
+                nodes = ev["nodes"]
+                if (not isinstance(nodes, list) or not nodes
+                        or not all(isinstance(x, int) and 0 <= x < n
+                                   for x in nodes)):
+                    raise ValueError(
+                        f"scenario event {ev}: 'nodes' must be a "
+                        f"non-empty list of indices in [0, N={n})")
+            else:
+                if ev["draw"] not in DRAW_KINDS:
+                    raise ValueError(
+                        f"scenario event {ev}: 'draw' must be one of "
+                        f"{DRAW_KINDS}")
+                if kind != "crash":
+                    raise ValueError(
+                        f"scenario event {ev}: 'draw' selectors are "
+                        "crash-only (restart/leave need a determined set)")
+        else:
+            start, stop = ev.get("start"), ev.get("stop")
+            if (not isinstance(start, int) or not isinstance(stop, int)
+                    or not 0 <= start < stop):
+                raise ValueError(
+                    f"scenario event {ev}: needs int 'start' < 'stop'")
+            if kind == "partition":
+                groups = ev.get("groups")
+                if (not isinstance(groups, list) or len(groups) < 2):
+                    raise ValueError(
+                        f"scenario event {ev}: 'groups' must list >= 2 "
+                        "contiguous index ranges")
+                prev = 0
+                for g in groups:
+                    if (not isinstance(g, (list, tuple)) or len(g) != 2
+                            or g[0] != prev or g[1] <= g[0]):
+                        raise ValueError(
+                            f"scenario event {ev}: groups must be "
+                            "ascending contiguous ranges tiling [0, N) "
+                            f"(got {groups})")
+                    prev = g[1]
+                if prev != n:
+                    raise ValueError(
+                        f"scenario event {ev}: groups cover [0, {prev}) "
+                        f"but N={n}")
+                part_spans.append((start, stop))
+            elif kind == "link_flake":
+                _check_range(ev, "src", n, kind)
+                _check_range(ev, "dst", n, kind)
+            if kind in ("link_flake", "drop_window"):
+                p = ev.get("drop_prob")
+                if not isinstance(p, (int, float)) or not 0 < p <= 1:
+                    raise ValueError(
+                        f"scenario event {ev}: 'drop_prob' must be in "
+                        "(0, 1]")
+    part_spans.sort()
+    for (s1, e1), (s2, e2) in zip(part_spans, part_spans[1:]):
+        if s2 < e1:
+            raise ValueError(
+                f"scenario {scn.name!r}: partition windows ({s1}, {e1}] "
+                f"and ({s2}, {e2}] overlap — at most one partition may "
+                "be active per tick (one group vector applies)")

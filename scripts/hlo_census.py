@@ -77,9 +77,27 @@ def _walk_eqns(jaxpr, visit):
                     _walk_eqns(sub, visit)
 
 
-def step_census(params) -> dict:
+def scenario_program(params, events):
+    """Compile an event list into the general-path ScenarioProgram at
+    this geometry (the scenario census's fixture builder)."""
+    import random
+
+    from distributed_membership_tpu.scenario.compile import (
+        compile_scenario)
+    from distributed_membership_tpu.scenario.schema import Scenario
+
+    plan = compile_scenario(
+        Scenario.from_dict({"name": "census", "events": events}),
+        params, random.Random("census"))
+    assert plan.scenario is not None, "census scenario lowered to legacy"
+    return plan.scenario
+
+
+def step_census(params, scenario=None) -> dict:
     """Trace one ring step for ``params`` (abstract shapes only — no
-    device buffers) and count the two flagged op classes."""
+    device buffers) and count the two flagged op classes.  ``scenario``
+    (a ScenarioProgram) arms the scenario tensor plan as the step's 8th
+    input."""
     import jax
     import jax.numpy as jnp
 
@@ -87,7 +105,9 @@ def step_census(params) -> dict:
         _get_step_and_init, make_config)
 
     n = params.EN_GPSZ
-    cfg = make_config(params, collect_events=False, fail_ids=(0,))
+    cfg = make_config(params, collect_events=False, fail_ids=(0,),
+                      scenario=None if scenario is None
+                      else scenario.static)
     step, init = _get_step_and_init(cfg, warm=True)
 
     key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
@@ -99,6 +119,10 @@ def step_census(params) -> dict:
            jax.ShapeDtypeStruct((), i32),
            jax.ShapeDtypeStruct((), i32),
            jax.ShapeDtypeStruct((), i32))
+    if scenario is not None:
+        inp = inp + (jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            scenario.tensors()),)
     traced = jax.jit(lambda st, inp: step(st, inp)).trace(state, inp)
 
     s = params.VIEW_SIZE
@@ -157,12 +181,40 @@ def full_census(n: int = 1 << 20, s: int = 16) -> dict:
     return out
 
 
+def scenario_census(n: int = 1 << 20, s: int = 16) -> dict:
+    """The scenario structural contract at (n, s): ``base`` (no
+    scenario), ``partition`` (one two-group window — deterministic
+    masking only, no coins), and ``chaos`` (partition + restart +
+    link_flake — the full general path).  tests/test_hlo_census.py pins
+    base == the default program and bounds the armed programs to
+    elementwise additions: no new threefry for coin-free partitions, no
+    new [N]-class gathers or scatters ever."""
+    params = census_params(n, s)
+    out = {"n": n, "s": s, "base": step_census(params)}
+    part = [{"kind": "partition", "start": 10, "stop": 40,
+             "groups": [[0, n // 2], [n // 2, n]]}]
+    out["partition"] = step_census(
+        params, scenario=scenario_program(params, part))
+    chaos = part + [
+        {"kind": "crash", "time": 12, "range": [0, 8]},
+        {"kind": "restart", "time": 30, "range": [0, 8]},
+        {"kind": "link_flake", "start": 15, "stop": 35,
+         "src": [0, n // 2], "dst": [n // 2, n], "drop_prob": 0.1},
+    ]
+    out["chaos"] = step_census(
+        params, scenario=scenario_program(params, chaos))
+    return out
+
+
 def main() -> int:
     import argparse
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1 << 20)
     ap.add_argument("--view", type=int, default=16)
+    ap.add_argument("--scenario", action="store_true",
+                    help="print the scenario-armed census (base vs "
+                         "partition vs full chaos) instead")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero unless the default program shows "
                          "exactly one probe-leg gather and fewer "
@@ -170,6 +222,9 @@ def main() -> int:
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    if args.scenario:
+        print(json.dumps(scenario_census(args.n, args.view)))
+        return 0
     out = full_census(args.n, args.view)
     print(json.dumps(out))
     if args.check:

@@ -124,6 +124,10 @@ def build_report(directory: str | None,
         rl_path = os.path.join(directory, "runlog.jsonl")
         if os.path.exists(rl_path):
             report["segments"] = _segment_stats(read_events(rl_path))
+        sc_path = os.path.join(directory, "scenario.json")
+        if os.path.exists(sc_path):
+            with open(sc_path) as fh:
+                report["scenario"] = json.load(fh)
     if ladder_path and os.path.exists(ladder_path):
         report["ladder"] = _ladder_stats(read_events(ladder_path))
     # Reconciliation: the per-tick series must sum to the run verdicts
@@ -136,7 +140,37 @@ def build_report(directory: str | None,
                 ds.get("false_removals", 0)
                 + ds.get("detections_total", 0)),
         }
+    # Scenario ↔ timeline cross-check: the oracle's event-count totals
+    # were computed from the same per-tick series the timeline section
+    # summarizes — any divergence means a torn artifact set.
+    sc = report.get("scenario")
+    if sc and tl and sc.get("totals"):
+        report.setdefault("reconciliation", {})
+        report["reconciliation"].update({
+            "scenario_joins_match":
+                sc["totals"]["joins_total"] == tl["joins_total"],
+            "scenario_removals_match":
+                sc["totals"]["removals_total"] == tl["removals_total"],
+        })
     return report
+
+
+def _scenario_markers(sc: dict) -> list:
+    """One marker line per scenario event, for inline rendering in the
+    timeline section."""
+    out = []
+    for ev in sc.get("events", ()):
+        kind = ev.get("kind")
+        if kind in ("crash", "leave", "restart"):
+            out.append(f"t={ev['time']}: **{kind}** "
+                       f"({ev.get('nodes', '?')} nodes)")
+        elif kind == "partition":
+            out.append(f"t={ev['start']}→{ev['stop']}: **partition** "
+                       "(heal at stop)")
+        else:
+            out.append(f"t={ev['start']}→{ev['stop']}: **{kind}** "
+                       f"p={ev.get('drop_prob')}")
+    return out
 
 
 def _md_kv(d: dict) -> list:
@@ -145,11 +179,32 @@ def _md_kv(d: dict) -> list:
 
 def render_markdown(report: dict) -> str:
     lines = ["# Flight-recorder run report", ""]
+    sc = report.get("scenario")
     tl = report.get("timeline")
     if tl:
-        lines += ["## Timeline (per-tick telemetry)", "",
-                  "| metric | value |", "|---|---|"]
+        lines += ["## Timeline (per-tick telemetry)", ""]
+        if sc:
+            # Scenario event markers inline, so the per-tick metrics
+            # read against the chaos schedule that produced them.
+            lines += [f"- {m}" for m in _scenario_markers(sc)]
+            lines.append("")
+        lines += ["| metric | value |", "|---|---|"]
         lines += _md_kv(tl)
+        lines.append("")
+    if sc:
+        lines += [f"## Scenario oracle — {sc.get('scenario', '?')}", "",
+                  "| metric | value |", "|---|---|"]
+        for i, p in enumerate(sc.get("partitions", ())):
+            lines += _md_kv({f"partition[{i}].{k}": v
+                             for k, v in p.items()})
+        for i, c in enumerate(sc.get("crashes", ())):
+            lines += _md_kv({f"crash[{i}].{k}": v for k, v in c.items()})
+        for i, rr in enumerate(sc.get("restarts", ())):
+            lines += _md_kv({f"restart[{i}].{k}": v
+                             for k, v in rr.items()})
+        if sc.get("final"):
+            lines += _md_kv({f"final.{k}": v
+                             for k, v in sc["final"].items()})
         lines.append("")
     ds = report.get("detection_summary")
     if ds:

@@ -38,9 +38,14 @@ def _topology_skip_reason() -> str | None:
     topology instead."""
     if "reason" not in _PROBE:
         try:
+            # 45 s bound: a real topology answers in seconds (local
+            # libtpu call); the hang mode is an unbounded native retry
+            # loop that a longer wait never rescues — at the previous
+            # 120 s this probe alone ate ~14% of the tier-1 budget on
+            # affected images.
             r = subprocess.run([sys.executable, SCRIPT, "--probe"],
                                capture_output=True, text=True,
-                               timeout=120, cwd=REPO)
+                               timeout=45, cwd=REPO)
             _PROBE["reason"] = (
                 None if "topology-ok" in r.stdout
                 else "libtpu topology unavailable on this host")

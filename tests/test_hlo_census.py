@@ -79,6 +79,43 @@ def test_telemetry_off_is_op_count_identical_and_on_is_bounded():
 
 
 @pytest.mark.quick
+def test_scenario_census_bounded_at_1m_s16():
+    """Scenario-engine structural contract at the [1M, 16] north-star
+    geometry: with no scenario the program is OP-COUNT IDENTICAL to the
+    default lowering (cfg.scenario None compiles nothing), and an armed
+    scenario adds only elementwise masking — a coin-free partition adds
+    ZERO threefry invocations and zero new [N]-class gathers/scatters;
+    the full chaos plan (partition + restart + flake) arms the drop-coin
+    streams (the same threefry count class as DROP_MSG=1) but still no
+    new gathers or scatters."""
+    out = hlo_census.scenario_census(n=1 << 20, s=16)
+    base = out["base"]
+
+    # No scenario: identical to the default census program.
+    plain = hlo_census.step_census(hlo_census.census_params(1 << 20, 16))
+    assert base == plain
+
+    for arm in ("partition", "chaos"):
+        c = out[arm]
+        assert c["big_gathers"] == base["big_gathers"], (arm, c)
+        assert c["big_gather_shapes"] == base["big_gather_shapes"]
+        assert c["big_scatters"] == base["big_scatters"], (arm, c)
+
+    # Deterministic partition masking consumes no RNG at all.
+    assert out["partition"]["threefry_calls"] == base["threefry_calls"]
+    # Elementwise additions stay bounded (event masks + group cuts).
+    assert 0 <= (out["partition"]["ns_class_ops"]
+                 - base["ns_class_ops"]) <= 16
+    # The chaos arm arms the drop streams: bounded by the msgdrop-class
+    # program's own draw count.
+    drops = hlo_census.step_census(hlo_census.census_params(
+        1 << 20, 16, drops=True))
+    assert out["chaos"]["threefry_calls"] <= drops["threefry_calls"]
+    assert 0 <= (out["chaos"]["ns_class_ops"]
+                 - base["ns_class_ops"]) <= 64
+
+
+@pytest.mark.quick
 def test_census_exact_mode_single_gather():
     """PROBE_IO exact (the default below 2^17) also rides the single
     combined gather — the DEFAULT exact path was the tentpole's target,

@@ -1,0 +1,520 @@
+"""Scenario engine (scenario/ package).
+
+Pins the subsystem's contracts end to end:
+
+  * schema validation rejects malformed schedules loudly;
+  * the shipped ``scenarios/*.json`` testcase twins reproduce the legacy
+    ``make_plan`` injection BIT-EXACTLY (same dbg.log on emul and
+    tpu_hash at N=10; same detection summary for the rack plan at
+    N=2048) — the legacy lowering runs the unchanged code path;
+  * the general tensor-plan path: partition false positives + heal,
+    crash/restart churn with fresh incarnations, link flakes — on
+    tpu_hash (natural AND folded, bit-exact twins), tpu_hash_sharded
+    (natural AND folded, virtual 8-device mesh), and emul;
+  * scenario x CHECKPOINT_EVERY: kill/resume at {50, 150, 400} with a
+    partition spanning checkpoint boundaries reproduces the
+    uninterrupted run byte-for-byte, including the oracle report;
+  * the N=2048 sharded partition-heal acceptance run (slow tier).
+"""
+
+import json
+import os
+import pathlib
+import random
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.runtime import checkpoint as ck
+from distributed_membership_tpu.runtime.application import run_conf
+from distributed_membership_tpu.runtime.failures import resolve_plan
+from distributed_membership_tpu.scenario.compile import compile_scenario
+from distributed_membership_tpu.scenario.schema import (
+    Scenario, load_scenario, validate_scenario)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TESTDIR = REPO / "testcases"
+SCNDIR = REPO / "scenarios"
+SEED = 3
+
+
+def _scn_file(tmp_path, events, name="t"):
+    p = tmp_path / f"{name}.json"
+    p.write_text(json.dumps({"name": name, "events": events}))
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# Schema
+
+
+@pytest.mark.quick
+def test_schema_validation_rejects_malformed():
+    def check(events, match):
+        with pytest.raises(ValueError, match=match):
+            validate_scenario(
+                Scenario.from_dict({"name": "x", "events": events}),
+                n=64, total=100)
+
+    check([{"kind": "nope", "time": 1}], "unknown event kind")
+    check([{"kind": "crash", "time": 200, "nodes": [1]}], "'time'")
+    check([{"kind": "crash", "time": 10}], "exactly one")
+    check([{"kind": "crash", "time": 10, "nodes": [99]}], "indices")
+    check([{"kind": "restart", "time": 10, "draw": "single"}],
+          "crash-only")
+    check([{"kind": "partition", "start": 5, "stop": 20,
+            "groups": [[0, 32], [40, 64]]}], "contiguous")
+    check([{"kind": "partition", "start": 5, "stop": 20,
+            "groups": [[0, 32], [32, 60]]}], "cover")
+    check([{"kind": "partition", "start": 5, "stop": 20,
+            "groups": [[0, 32], [32, 64]]},
+           {"kind": "partition", "start": 15, "stop": 30,
+            "groups": [[0, 16], [16, 64]]}], "overlap")
+    check([{"kind": "link_flake", "start": 5, "stop": 20,
+            "src": [0, 32], "dst": [32, 64], "drop_prob": 2.0}],
+          "drop_prob")
+    check([{"kind": "drop_window", "start": 20, "stop": 5,
+            "drop_prob": 0.1}], "start")
+    # Well-formed passes.
+    validate_scenario(Scenario.from_dict({"events": [
+        {"kind": "crash", "time": 10, "range": [0, 4]},
+        {"kind": "restart", "time": 50, "range": [0, 4]},
+        {"kind": "partition", "start": 5, "stop": 20,
+         "groups": [[0, 32], [32, 64]]}]}), n=64, total=100)
+
+
+@pytest.mark.quick
+def test_shipped_scenarios_parse():
+    for p in sorted(SCNDIR.glob("*.json")):
+        scn = load_scenario(str(p))
+        assert scn.events, p
+        validate_scenario(scn, n=2048, total=700)
+
+
+@pytest.mark.quick
+def test_general_path_rejected_on_unsupported_backends(tmp_path):
+    spath = _scn_file(tmp_path, [
+        {"kind": "partition", "start": 5, "stop": 20,
+         "groups": [[0, 5], [5, 10]]}])
+    params = Params.from_text(
+        "MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"TOTAL_TIME: 60\nSCENARIO: {spath}\nBACKEND: tpu_sparse\n")
+    with pytest.raises(ValueError, match="general tensor-plan path"):
+        resolve_plan(params, random.Random("app:0"))
+    # The hash backends reject the scatter exchange loudly too.
+    params2 = Params.from_text(
+        "MAX_NNB: 10\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"TOTAL_TIME: 60\nSCENARIO: {spath}\nBACKEND: tpu_hash\n")
+    with pytest.raises(ValueError, match="ring exchange"):
+        get_backend("tpu_hash")(params2, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# Legacy twins: scenario files reproduce make_plan bit-exactly
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+@pytest.mark.parametrize("backend", ["emul", "tpu_hash"])
+def test_testcase_twin_bit_exact(scenario, backend, tmp_path):
+    r0 = run_conf(str(TESTDIR / f"{scenario}.conf"), backend=backend,
+                  seed=SEED, out_dir=str(tmp_path / "plain"))
+    r1 = run_conf(str(TESTDIR / f"{scenario}.conf"), backend=backend,
+                  seed=SEED, out_dir=str(tmp_path / "scn"),
+                  scenario=str(SCNDIR / f"{scenario}.json"))
+    assert r1.log.dbg_text() == r0.log.dbg_text()
+    assert r1.failed_indices == r0.failed_indices
+    assert np.array_equal(r1.sent, r0.sent)
+
+
+@pytest.mark.slow
+def test_rack_twin_n2048_detection_summary(tmp_path):
+    """The rack draw twin at N=2048 (agg mode): same seeded rack set,
+    identical detection summary — the scenario path IS make_plan here.
+    (Slow tier for the N=2048 compile; the legacy lowering it pins is
+    the same code path the N=10 twins above exercise in tier 1.)"""
+    base = ("MAX_NNB: 2048\nSINGLE_FAILURE: 0\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nRACK_SIZE: 64\nRACK_FAILURES: 2\n"
+            "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nFANOUT: 3\n"
+            "TFAIL: 8\nTREMOVE: 20\nTOTAL_TIME: 120\nFAIL_TIME: 40\n"
+            "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+            "BACKEND: tpu_hash\n")
+    spath = _scn_file(tmp_path, [
+        {"kind": "crash", "time": 40, "draw": "racks"}], "racks")
+    r0 = get_backend("tpu_hash")(Params.from_text(base), seed=SEED)
+    r1 = get_backend("tpu_hash")(
+        Params.from_text(base + f"SCENARIO: {spath}\n"), seed=SEED)
+    assert r1.failed_indices == r0.failed_indices
+    assert len(r0.failed_indices) == 128          # 2 racks of 64
+    assert (r1.extra["detection_summary"]
+            == r0.extra["detection_summary"])
+    assert np.array_equal(r1.sent, r0.sent)
+
+
+# ---------------------------------------------------------------------------
+# General path mechanics
+
+
+_GENERAL_N = 128
+_GENERAL_BASE = (
+    f"MAX_NNB: {_GENERAL_N}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\n"
+    "MSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nFANOUT: 3\n"
+    "TFAIL: 8\nTREMOVE: 20\nTOTAL_TIME: 170\nJOIN_MODE: warm\n"
+    "EVENT_MODE: agg\nEXCHANGE: ring\nTELEMETRY: scalars\n")
+
+_CHAOS_EVENTS = [
+    {"kind": "partition", "start": 20, "stop": 80,
+     "groups": [[0, 64], [64, 128]]},
+    {"kind": "crash", "time": 30, "range": [4, 8]},
+    {"kind": "restart", "time": 100, "range": [4, 8]},
+    {"kind": "link_flake", "start": 110, "stop": 150,
+     "src": [0, 64], "dst": [64, 128], "drop_prob": 0.2},
+]
+
+
+@pytest.mark.quick
+def test_partition_heal_oracle_tpu_hash(tmp_path):
+    spath = _scn_file(tmp_path, [
+        {"kind": "partition", "start": 20, "stop": 80,
+         "groups": [[0, 64], [64, 128]]}], "ph")
+    r = get_backend("tpu_hash")(Params.from_text(
+        _GENERAL_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"),
+        seed=5)
+    rep = r.extra["scenario_report"]
+    p = rep["partitions"][0]
+    # The partition produced false-positive removals of live nodes...
+    assert p["removals_during"] > 0
+    assert r.extra["detection_summary"]["false_removals"] \
+        == p["removals_during"]
+    # ...every one healed by re-admission, and the membership
+    # re-converged after the heal.
+    assert p["unhealed_removals"] == 0
+    assert p["reconverged_tick"] is not None
+    assert p["reconverged_tick"] > p["start"]
+    assert rep["final"]["live"] == _GENERAL_N
+    assert rep["final"]["failed"] == 0
+    assert rep["final"]["suspected_entries"] == 0
+
+
+@pytest.mark.slow
+def test_chaos_natural_folded_bit_exact(tmp_path):
+    """crash + restart + partition + flake: the folded [N/F, 128] twin
+    reproduces the natural trajectory bit-for-bit under the full
+    general path (the fold contract extends to the scenario masks).
+    (Slow tier: tier 1 keeps the natural/folded twin comparison via
+    test_partition_heal_sharded_small's two arms.)"""
+    spath = _scn_file(tmp_path, _CHAOS_EVENTS, "chaos")
+    base = _GENERAL_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"
+    r_nat = get_backend("tpu_hash")(
+        Params.from_text(base + "FOLDED: 0\n"), seed=5)
+    r_fold = get_backend("tpu_hash")(
+        Params.from_text(base + "FOLDED: 1\n"), seed=5)
+    assert (r_nat.extra["detection_summary"]
+            == r_fold.extra["detection_summary"])
+    assert np.array_equal(r_nat.sent, r_fold.sent)
+    assert (r_nat.extra["scenario_report"]
+            == r_fold.extra["scenario_report"])
+    rep = r_nat.extra["scenario_report"]
+    assert rep["restarts"][0]["rejoined"] is True
+    assert rep["final"]["live"] == _GENERAL_N   # everyone back
+
+
+def test_restart_fresh_incarnation_rejoins(tmp_path):
+    """Crash a block, restart it, and pin that the rejoined nodes are
+    live, unsuspected members at the end (fresh incarnation dominated
+    the stale gossip)."""
+    spath = _scn_file(tmp_path, [
+        {"kind": "crash", "time": 40, "range": [16, 32]},
+        {"kind": "restart", "time": 100, "range": [16, 32]}], "churn")
+    r = get_backend("tpu_hash")(Params.from_text(
+        _GENERAL_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"),
+        seed=9)
+    rep = r.extra["scenario_report"]
+    assert rep["crashes"][0]["removals_within_2tremove"] > 0
+    assert rep["restarts"][0]["rejoined"] is True
+    assert rep["restarts"][0]["joins_after"] > 0
+    assert rep["final"]["live"] == _GENERAL_N
+    assert rep["final"]["failed"] == 0
+    fs = r.extra["final_state"]
+    assert not np.asarray(fs.failed)[16:32].any()
+
+
+def test_link_flake_drops_messages(tmp_path):
+    """A directed cross-half flake window: the telemetry 'dropped'
+    series is nonzero exactly inside the window, and the trajectory
+    diverges from the flake-free run."""
+    spath = _scn_file(tmp_path, [
+        {"kind": "link_flake", "start": 50, "stop": 120,
+         "src": [0, 64], "dst": [64, 128], "drop_prob": 0.5}], "fl")
+    base = _GENERAL_BASE + "BACKEND: tpu_hash\n"
+    r0 = get_backend("tpu_hash")(Params.from_text(base), seed=5)
+    r1 = get_backend("tpu_hash")(
+        Params.from_text(base + f"SCENARIO: {spath}\n"), seed=5)
+    tl = r1.extra["timeline"]
+    dropped = np.asarray(tl["dropped"])
+    assert dropped[51:121].sum() > 0
+    assert dropped[:50].sum() == 0
+    assert dropped[122:].sum() == 0
+    assert not np.array_equal(r0.sent, r1.sent)
+
+
+def test_emul_general_scenario_parity(tmp_path):
+    """The emul host twin runs the same chaos schedule: same report
+    structure, partition heals, restarts rejoin (trajectories differ —
+    host RNG — but the oracle verdicts agree)."""
+    spath = _scn_file(tmp_path, [
+        {"kind": "partition", "start": 30, "stop": 60,
+         "groups": [[0, 5], [5, 10]]},
+        {"kind": "crash", "time": 80, "nodes": [7]},
+        {"kind": "restart", "time": 120, "nodes": [7]}], "em")
+    r = run_conf(str(TESTDIR / "singlefailure.conf"), backend="emul",
+                 seed=SEED, out_dir=str(tmp_path / "o"),
+                 scenario=spath)
+    rep = r.extra["scenario_report"]
+    assert rep["basis"] == "dbg"
+    assert rep["restarts"][0]["rejoined"] is True
+    assert rep["final"]["live"] == 10
+    assert rep["final"]["failed"] == 0
+    # The crash was detected (removals of node 7 after t=80).
+    assert rep["crashes"][0]["removals_within_2tremove"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Scenario x checkpoint/resume (satellite: kills at {50, 150, 400} with
+# a partition spanning checkpoint boundaries)
+
+
+_RESUME_BASE = (
+    "MAX_NNB: 32\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nFANOUT: 3\n"
+    "TFAIL: 8\nTREMOVE: 20\nTOTAL_TIME: 450\nJOIN_MODE: warm\n"
+    "EVENT_MODE: agg\nEXCHANGE: ring\nTELEMETRY: scalars\n")
+
+_RESUME_EVENTS = [
+    {"kind": "partition", "start": 120, "stop": 380,
+     "groups": [[0, 16], [16, 32]]},
+    {"kind": "crash", "time": 60, "range": [4, 6]},
+    {"kind": "restart", "time": 420, "range": [4, 6]},
+]
+
+
+_SCN_REF: dict = {}
+
+
+def _resume_reference(tmp_path_factory):
+    """Uninterrupted monolithic reference for the kill matrix (one run
+    shared by the three kill ticks — test_checkpoint's _REF pattern)."""
+    if "r0" not in _SCN_REF:
+        d = tmp_path_factory.mktemp("scn_ref")
+        spath = _scn_file(d, _RESUME_EVENTS, "resume")
+        base = _RESUME_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"
+        _SCN_REF["r0"] = get_backend("tpu_hash")(Params.from_text(
+            base + f"TELEMETRY_DIR: {d}/tl0\n"), seed=SEED)
+    return _SCN_REF["r0"]
+
+
+@pytest.mark.parametrize("kill", [
+    pytest.param(50, marks=pytest.mark.slow),      # before the partition
+    150,                                           # inside it
+    pytest.param(400, marks=pytest.mark.slow),     # after the heal
+])
+def test_scenario_kill_resume_bit_exact(kill, tmp_path,
+                                        tmp_path_factory, monkeypatch):
+    """A partition spanning several checkpoint boundaries: kill before
+    it, inside it, and after the heal — the resumed run reproduces the
+    uninterrupted run's summary, message counters, and oracle report.
+    The mid-partition kill runs in tier 1; the flanking ticks ride the
+    slow tier (same harness, same pins)."""
+    spath = _scn_file(tmp_path, _RESUME_EVENTS, "resume")
+    base = _RESUME_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"
+    r0 = _resume_reference(tmp_path_factory)
+    ckdir = tmp_path / "ck"
+    ckeys = (f"CHECKPOINT_EVERY: 50\nCHECKPOINT_DIR: {ckdir}\n"
+             f"TELEMETRY_DIR: {tmp_path}/tl1\n")
+    monkeypatch.setenv(ck.CRASH_ENV, str(kill))
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_backend("tpu_hash")(Params.from_text(base + ckeys),
+                                seed=SEED)
+    assert ck.manifest_tick(str(ckdir)) == (kill // 50) * 50
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r1 = get_backend("tpu_hash")(Params.from_text(
+        base + ckeys + "RESUME: 1\n"), seed=SEED)
+    assert (r1.extra["detection_summary"]
+            == r0.extra["detection_summary"])
+    assert np.array_equal(r1.sent, r0.sent)
+    assert (r1.extra["scenario_report"]
+            == r0.extra["scenario_report"])
+    assert r1.extra["scenario_report"]["partitions"][0][
+        "unhealed_removals"] == 0
+
+
+@pytest.mark.quick
+def test_resume_rejects_edited_scenario_file(tmp_path, monkeypatch):
+    """The manifest pins the scenario file's content digest: an edited
+    schedule must not silently resume into a different chaos plan."""
+    spath = _scn_file(tmp_path, _RESUME_EVENTS, "resume")
+    base = _RESUME_BASE + f"SCENARIO: {spath}\nBACKEND: tpu_hash\n"
+    ckdir = tmp_path / "ck"
+    ckeys = f"CHECKPOINT_EVERY: 50\nCHECKPOINT_DIR: {ckdir}\n"
+    monkeypatch.setenv(ck.CRASH_ENV, "150")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_backend("tpu_hash")(Params.from_text(base + ckeys),
+                                seed=SEED)
+    monkeypatch.delenv(ck.CRASH_ENV)
+    edited = dict(json.loads(pathlib.Path(spath).read_text()))
+    edited["events"] = list(edited["events"]) + [
+        {"kind": "drop_window", "start": 10, "stop": 20,
+         "drop_prob": 0.5}]
+    pathlib.Path(spath).write_text(json.dumps(edited))
+    with pytest.raises(ValueError, match="manifest mismatch"):
+        get_backend("tpu_hash")(Params.from_text(
+            base + ckeys + "RESUME: 1\n"), seed=SEED)
+
+
+# ---------------------------------------------------------------------------
+# Sharded acceptance
+
+
+def _sharded_partition_runs(tmp_path, n, tag, total=160, start=40,
+                            stop=96, seed=7):
+    spath = _scn_file(tmp_path, [
+        {"kind": "partition", "start": start, "stop": stop,
+         "groups": [[0, n // 2], [n // 2, n]]}], tag)
+    base = (f"MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
+            "PROBES: 4\nFANOUT: 3\nTFAIL: 8\nTREMOVE: 20\n"
+            f"TOTAL_TIME: {total}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "EXCHANGE: ring\nTELEMETRY: scalars\n"
+            f"SCENARIO: {spath}\nBACKEND: tpu_hash_sharded\n")
+    r_nat = get_backend("tpu_hash_sharded")(
+        Params.from_text(base + "FOLDED: 0\n"), seed=seed)
+    r_fold = get_backend("tpu_hash_sharded")(
+        Params.from_text(base + "FOLDED: 1\n"), seed=seed)
+    ckdir = tmp_path / f"ck_{tag}"
+    ckeys = (f"CHECKPOINT_EVERY: 40\nCHECKPOINT_DIR: {ckdir}\n"
+             f"TELEMETRY_DIR: {tmp_path}/tl_{tag}\n")
+    kill = (start + stop) // 2            # mid-partition
+    os.environ[ck.CRASH_ENV] = str(kill)
+    try:
+        with pytest.raises(RuntimeError, match="injected crash"):
+            get_backend("tpu_hash_sharded")(
+                Params.from_text(base + ckeys), seed=seed)
+    finally:
+        del os.environ[ck.CRASH_ENV]
+    r_res = get_backend("tpu_hash_sharded")(
+        Params.from_text(base + ckeys + "RESUME: 1\n"), seed=seed)
+    return r_nat, r_fold, r_res
+
+
+def _assert_partition_acceptance(r_nat, r_fold, r_res, n):
+    rep = r_nat.extra["scenario_report"]
+    p = rep["partitions"][0]
+    # Zero permanent removals of live partitioned nodes after heal,
+    # with a measured re-convergence tick...
+    assert p["unhealed_removals"] == 0
+    assert p["reconverged_tick"] is not None
+    assert rep["final"]["live"] == n
+    assert rep["final"]["suspected_entries"] == 0
+    # ...identical across the natural/folded twins...
+    assert (r_fold.extra["scenario_report"] == rep)
+    assert (r_fold.extra["detection_summary"]
+            == r_nat.extra["detection_summary"])
+    assert np.array_equal(r_fold.sent, r_nat.sent)
+    # ...and across a mid-partition kill/resume.
+    assert r_res.extra["scenario_report"] == rep
+    assert (r_res.extra["detection_summary"]
+            == r_nat.extra["detection_summary"])
+
+
+def test_partition_heal_sharded_small(tmp_path):
+    r_nat, r_fold, r_res = _sharded_partition_runs(tmp_path, 256, "s256")
+    _assert_partition_acceptance(r_nat, r_fold, r_res, 256)
+
+
+@pytest.mark.slow
+def test_partition_heal_sharded_n2048_acceptance(tmp_path):
+    """The ISSUE's acceptance run: partition-heal at N=2048 on the
+    sharded backend (virtual 8-device mesh)."""
+    r_nat, r_fold, r_res = _sharded_partition_runs(
+        tmp_path, 2048, "s2048", total=200, start=40, stop=120)
+    _assert_partition_acceptance(r_nat, r_fold, r_res, 2048)
+    assert r_nat.extra["scenario_report"]["partitions"][0][
+        "removals_during"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Compiler details
+
+
+@pytest.mark.quick
+def test_compile_permanent_failures_and_windows(tmp_path):
+    params = Params.from_text(
+        "MAX_NNB: 64\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nTFAIL: 8\n"
+        "TREMOVE: 24\nTOTAL_TIME: 200\nJOIN_MODE: warm\n"
+        "EXCHANGE: ring\nEVENT_MODE: agg\nBACKEND: tpu_hash\n")
+    scn = Scenario.from_dict({"name": "x", "events": [
+        {"kind": "crash", "time": 20, "range": [0, 8]},
+        {"kind": "restart", "time": 60, "range": [0, 4]},
+        {"kind": "leave", "time": 90, "nodes": [10]},
+        {"kind": "drop_window", "start": 30, "stop": 70,
+         "drop_prob": 0.157},
+    ]})
+    plan = compile_scenario(scn, params, random.Random("app:0"))
+    # Nodes 4..7 crashed and never restarted; node 10 left: permanent.
+    assert plan.failed_indices == [4, 5, 6, 7, 10]
+    assert plan.fail_time == 20
+    assert plan.kind == "scenario"
+    prog = plan.scenario
+    assert prog.static.has_drop and prog.static.has_updown
+    # Probabilities quantize to integer percent (EmulNet semantics).
+    assert prog.drop_windows[0]["drop_prob"] == 0.15
+    # Tensor shapes are padded to >= 1 and match the static descriptor.
+    tens = prog.numpy_tensors()
+    assert tens.ev_time.shape == (prog.static.n_events,)
+    assert (tens.part_cut == 64).all()          # no partitions: inert
+
+
+@pytest.mark.quick
+def test_host_twin_matches_tensor_semantics(tmp_path):
+    """ScenarioHost (emul) and the tensor helpers agree on window
+    activation, partition cuts, and the drop-prob combine."""
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.scenario.compile import (
+        base_drop_prob, cross_group, cuts_at, site_drop_prob)
+
+    params = Params.from_text(
+        "MAX_NNB: 64\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nTFAIL: 8\n"
+        "TREMOVE: 24\nTOTAL_TIME: 200\nJOIN_MODE: warm\n"
+        "EXCHANGE: ring\nEVENT_MODE: agg\nBACKEND: tpu_hash\n")
+    scn = Scenario.from_dict({"name": "x", "events": [
+        {"kind": "partition", "start": 10, "stop": 50,
+         "groups": [[0, 16], [16, 48], [48, 64]]},
+        {"kind": "link_flake", "start": 20, "stop": 60,
+         "src": [0, 32], "dst": [32, 64], "drop_prob": 0.2},
+        {"kind": "drop_window", "start": 40, "stop": 80,
+         "drop_prob": 0.1},
+    ]})
+    plan = compile_scenario(scn, params, random.Random("app:0"))
+    prog = plan.scenario
+    host = prog.host()
+    tens = prog.tensors()
+    idx = jnp.arange(64)
+    for t in (5, 11, 25, 45, 55, 75, 90):
+        cuts = cuts_at(tens, t, 64)
+        blocked = np.asarray(cross_group(cuts, idx[:, None], idx[None]))
+        for src, dst in ((0, 20), (20, 50), (5, 10), (50, 63)):
+            assert host.blocked(t, src, dst) == bool(blocked[src, dst]), \
+                (t, src, dst)
+            p = float(np.asarray(site_drop_prob(
+                prog.static, tens, t, jnp.asarray(src),
+                jnp.asarray(dst))))
+            assert host.drop_pct(t, src, dst) == int(p * 100), \
+                (t, src, dst)
+        assert float(base_drop_prob(tens, t)) == float(
+            np.float32(0.1) if 40 < t <= 80 else 0.0)
