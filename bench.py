@@ -34,7 +34,14 @@ BENCH_CHECKPOINT=K (+ BENCH_CHECKPOINT_COMPRESS=1) re-times the leg
 chunked with async-written snapshots, BENCH_RNG=1 adds the
 batched-vs-scattered threefry micro (ops/rng_plan) at the leg geometry,
 BENCH_TELEMETRY=1 re-times the leg with the flight recorder's in-scan
-per-tick scalars armed (TELEMETRY: scalars, observability/timeline.py).
+per-tick scalars armed (TELEMETRY: scalars, observability/timeline.py),
+BENCH_HIST=1 the same with the histogram tier on top (TELEMETRY: hist —
+the in-graph bucketed one-hot reductions; its overhead row lands in
+PERF.md).
+
+Every live leg row is also banked into ``artifacts/perf_ledger.jsonl``
+(observability/perfdb.py) and checked against history; a regression
+beyond the noise band prints a warning but never fails the bench.
 """
 
 from __future__ import annotations
@@ -65,6 +72,26 @@ def _timed_runs(run_scan, params, plan, ticks):
                               total_time=ticks)
     jax.block_until_ready(final_state)
     return time.perf_counter() - t0, final_state
+
+
+def _interleaved_best(run_scan, ticks: int, base: tuple, arms: dict,
+                      reps: int, base_wall: float) -> dict:
+    """Interleaved best-of-R pairing, min per variant: single-shot walls
+    on a busy host swing +-10%, drowning the few-percent overheads these
+    comparison legs measure, so each arm is re-timed alongside the base
+    and the per-variant minima are compared.  ``base``/``arms`` values
+    are (params, plan) pairs; ``base_wall`` seeds the base's best with
+    the wall the leg already measured.  Returns ``{"base": best, **{arm:
+    best}}``."""
+    walls = {"base": base_wall, **{name: None for name in arms}}
+    for i in range(reps):
+        if i > 0:
+            b, _ = _timed_runs(run_scan, base[0], base[1], ticks)
+            walls["base"] = min(walls["base"], b)
+        for name, (pp, pl) in arms.items():
+            w, _ = _timed_runs(run_scan, pp, pl, ticks)
+            walls[name] = w if walls[name] is None else min(walls[name], w)
+    return walls
 
 
 def _bench_rng_micro(cfg) -> dict:
@@ -224,20 +251,29 @@ def leg_hash(n: int, ticks: int, pin: str | None,
     # in-scan overhead the ISSUE bounds at <= 3% on CPU at 65k_s16).
     if os.environ.get("BENCH_TELEMETRY", "0") not in ("", "0"):
         params_tel = Params.from_text(params_text + "TELEMETRY: scalars\n")
-        # Interleaved best-of-R pairs, min per variant: single-shot walls
-        # on a busy host swing +-10%, drowning a few-percent overhead.
         reps = int(os.environ.get("BENCH_TELEMETRY_REPS", "3"))
-        tel_wall, _ = _timed_runs(run_scan, params_tel, plan, ticks)
-        base_best = wall
-        for _ in range(max(reps - 1, 0)):
-            b, _ = _timed_runs(run_scan, params, plan, ticks)
-            t, _ = _timed_runs(run_scan, params_tel, plan, ticks)
-            base_best = min(base_best, b)
-            tel_wall = min(tel_wall, t)
+        walls = _interleaved_best(run_scan, ticks, (params, plan),
+                                  {"tel": (params_tel, plan)}, reps, wall)
         ckpt_fields.update({
-            "telemetry_wall_seconds": round(tel_wall, 3),
+            "telemetry_wall_seconds": round(walls["tel"], 3),
             "telemetry_overhead_pct": round(
-                100 * (tel_wall - base_best) / max(base_best, 1e-9), 1),
+                100 * (walls["tel"] - walls["base"])
+                / max(walls["base"], 1e-9), 1),
+        })
+    # BENCH_HIST=1: price the histogram tier (TELEMETRY: hist) — the
+    # scalars PLUS the in-graph bucketed one-hot distribution reductions
+    # (observability/timeline.py build_tick_hist).  Same interleaved
+    # protocol; the ISSUE bounds this at <= 5% on CPU at 65k_s16.
+    if os.environ.get("BENCH_HIST", "0") not in ("", "0"):
+        params_hist = Params.from_text(params_text + "TELEMETRY: hist\n")
+        reps = int(os.environ.get("BENCH_HIST_REPS", "3"))
+        walls = _interleaved_best(run_scan, ticks, (params, plan),
+                                  {"hist": (params_hist, plan)}, reps, wall)
+        ckpt_fields.update({
+            "hist_wall_seconds": round(walls["hist"], 3),
+            "hist_overhead_pct": round(
+                100 * (walls["hist"] - walls["base"])
+                / max(walls["base"], 1e-9), 1),
         })
     # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
     # (scenario/compile.py) at this leg's geometry, isolating the two
@@ -284,19 +320,11 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         plan_droppy = make_plan(params_droppy, _pyrandom.Random("app:0"))
         try:
             reps = int(os.environ.get("BENCH_SCENARIO_REPS", "3"))
-            walls = {"base": wall, "part": None, "droppy": None,
-                     "flake": None}
-            arms = (("part", p_part, plan_part),
-                    ("droppy", params_droppy, plan_droppy),
-                    ("flake", p_flake, plan_flake))
-            for i in range(reps):
-                if i > 0:
-                    b, _ = _timed_runs(run_scan, params, plan, ticks)
-                    walls["base"] = min(walls["base"], b)
-                for name, pp, pl in arms:
-                    w, _ = _timed_runs(run_scan, pp, pl, ticks)
-                    walls[name] = (w if walls[name] is None
-                                   else min(walls[name], w))
+            walls = _interleaved_best(
+                run_scan, ticks, (params, plan),
+                {"part": (p_part, plan_part),
+                 "droppy": (params_droppy, plan_droppy),
+                 "flake": (p_flake, plan_flake)}, reps, wall)
             ckpt_fields.update({
                 "scenario_partition_wall_seconds": round(
                     walls["part"], 3),
@@ -489,6 +517,33 @@ def _banked_displaces_live(banked: dict | None, live: dict) -> bool:
             == (live.get("shift_set") or 0))
 
 
+def _ledger_bank(leg: str, row: dict) -> None:
+    """Bank a live leg row into artifacts/perf_ledger.jsonl and warn on
+    regressions vs banked history (observability/perfdb.py).  The ledger
+    is telemetry: any failure here is a warning, never a bench failure."""
+    try:
+        from distributed_membership_tpu.observability import perfdb
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, perfdb.LEDGER_PATH)
+        rows = [perfdb.make_row(
+            f"bench:live:{leg}", metric="node_ticks_per_sec",
+            value=row["node_ticks_per_sec"], n=row.get("n"),
+            s=row.get("view_size"),
+            backend="tpu_hash" if leg == "hash" else "dense",
+            platform=row.get("platform"),
+            knobs={k: row[k] for k in ("ticks", "exchange", "mode")
+                   if k in row},
+            source="bench.py")]
+        perfdb.append_rows(rows, path)
+        for reg in perfdb.check(perfdb.load_ledger(path)):
+            print(f"warning: perf_ledger regression: {reg['rung']} "
+                  f"{reg['metric']} {reg['value']:.1f} vs best "
+                  f"{reg['best']:.1f} (-{reg['drop_pct']}%)",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"warning: perf ledger update failed: {e}", file=sys.stderr)
+
+
 def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
              timeout: float, view: int = 0) -> dict | None:
     cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
@@ -518,10 +573,13 @@ def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
               + "\n  ".join(tail), file=sys.stderr)
         return None
     try:
-        return json.loads(r.stdout.strip().splitlines()[-1])
+        row = json.loads(r.stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
         print(f"warning: bench leg {leg} produced no JSON", file=sys.stderr)
         return None
+    if isinstance(row, dict) and row.get("node_ticks_per_sec"):
+        _ledger_bank(leg, row)
+    return row
 
 
 def main() -> int:

@@ -92,7 +92,8 @@ from distributed_membership_tpu.observability.aggregates import (
     FAST_AGG_MAX_FAILED, AggStats, init_agg, init_fast_agg, update_agg,
     update_fast_agg)
 from distributed_membership_tpu.observability.timeline import (
-    PHASE_ACK, PHASE_GOSSIP, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry)
+    PHASE_ACK, PHASE_GOSSIP, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry,
+    build_tick_hist)
 from distributed_membership_tpu.ops.fused_gossip import (
     gossip_fused, gossip_fused_stacked, gossip_fused_supported)
 from distributed_membership_tpu.ops.fused_receive import (
@@ -362,6 +363,14 @@ class HashConfig:
     #                              so the off program is op-identical to
     #                              the pre-flight-recorder lowering
     #                              (tests/test_hlo_census.py).  Ring only.
+    telemetry_hist: bool = False  # TELEMETRY: hist — additionally emit
+    #                              the per-tick TickHist fixed-bucket
+    #                              histograms (staleness / suspicion age
+    #                              / detection latency / occupancy /
+    #                              drops) as bucketed one-hot reductions
+    #                              over tensors the step already holds:
+    #                              no RNG, no gathers, no scatters
+    #                              (census-pinned).  Implies telemetry.
     scenario: object = None      # General-path scenario structural
     #                              descriptor (scenario/compile.py
     #                              ScenarioStatic — hashable, so it keys
@@ -1413,6 +1422,17 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     dropped=dropped_tick,
                     probe_acks=ack_recv_cnt.sum(dtype=I32),
                     gossip_rows=sent_gossip.sum(dtype=I32))
+                if cfg.telemetry_hist:
+                    # Distribution tier: bucketed one-hot reductions
+                    # over the post-receive staleness/occupancy tensors
+                    # (observability/timeline.py — shared builders, so
+                    # all four twins emit bit-equal counts).
+                    hist = build_tick_hist(
+                        difft=difft, present=present, size=size,
+                        act=act, t=t, fail_time=fail_time,
+                        tfail=cfg.tfail, det_tick=det_tick,
+                        dropped=dropped_tick)
+                    return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
 
@@ -1654,7 +1674,8 @@ def make_config(params: Params, collect_events: bool = True,
                       if exchange == "ring" and params.PROBES > 0
                       and n >= 4 else
                       "split" if n < 4 else "packed"),
-        telemetry=params.TELEMETRY == "scalars",
+        telemetry=params.TELEMETRY in ("scalars", "hist"),
+        telemetry_hist=params.TELEMETRY == "hist",
         scenario=scenario)
 
 
@@ -1794,10 +1815,11 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     """Run the full simulation; returns (final_state, events).
 
     ``telemetry`` (a TimelineRecorder, observability/timeline.py) receives
-    the per-tick scalar series when ``TELEMETRY: scalars`` is on — per
-    segment boundary on the chunked path, once at the end of a monolithic
-    scan.  With telemetry on and no recorder the series is computed and
-    dropped (the bench's overhead leg times exactly this)."""
+    the per-tick scalar series when ``TELEMETRY: scalars`` is on (a
+    ``(scalars, hist)`` pair under ``TELEMETRY: hist``) — per segment
+    boundary on the chunked path, once at the end of a monolithic scan.
+    With telemetry on and no recorder the series is computed and dropped
+    (the bench's overhead legs time exactly this)."""
     scn_prog = getattr(plan, "scenario", None)
     cfg = make_config(params, collect_events, fail_ids=plan_fail_ids(plan),
                       scenario=None if scn_prog is None

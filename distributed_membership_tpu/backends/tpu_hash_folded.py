@@ -652,21 +652,34 @@ def make_folded_step(cfg):
             # quantities on folded planes (bit-equal by the fold
             # contract; tests/test_timeline.py).
             from distributed_membership_tpu.observability.timeline import (
-                PHASE_TELEMETRY, TickTelemetry)
+                PHASE_TELEMETRY, TickTelemetry, build_tick_hist)
             with jax.named_scope(PHASE_TELEMETRY):
                 zero = jnp.zeros((), I32)
+                det_tick = (agg.det_count.sum(dtype=I32)
+                            - state.agg.det_count.sum(dtype=I32))
+                dropped_tick = sum(telem_dropped, zero)
                 telem = TickTelemetry(
                     live=act.sum(dtype=I32),
                     suspected=numfailed.sum(dtype=I32),
                     joins=out.join_ids,
                     removals=out.rm_ids,
-                    detections=(agg.det_count.sum(dtype=I32)
-                                - state.agg.det_count.sum(dtype=I32)),
+                    detections=det_tick,
                     msgs_sent=out.sent,
                     msgs_recv=out.recv,
-                    dropped=sum(telem_dropped, zero),
+                    dropped=dropped_tick,
                     probe_acks=ack_recv_cnt.sum(dtype=I32),
                     gossip_rows=sent_gossip.sum(dtype=I32))
+                if cfg.telemetry_hist:
+                    # difft/present are folded planes; the shared
+                    # builder reduces over every axis, and a fold is a
+                    # reshape, so the counts are bit-equal to the
+                    # natural twin's.
+                    hist = build_tick_hist(
+                        difft=difft, present=present, size=size,
+                        act=act, t=t, fail_time=fail_time,
+                        tfail=cfg.tfail, det_tick=det_tick,
+                        dropped=dropped_tick)
+                    return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
 
@@ -1046,22 +1059,34 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             # Sharded flight-recorder scalars: local reductions + one
             # psum each (observability/timeline.py).
             from distributed_membership_tpu.observability.timeline import (
-                PHASE_TELEMETRY, TickTelemetry)
+                PHASE_TELEMETRY, TickTelemetry, build_tick_hist)
             with jax.named_scope(PHASE_TELEMETRY):
                 zero = jnp.zeros((), I32)
+                det_local = (agg.det_count.sum(dtype=I32)
+                             - state.agg.det_count.sum(dtype=I32))
+                dropped_g = lax.psum(sum(telem_dropped, zero), AX)
                 telem = TickTelemetry(
                     live=lax.psum(act.sum(dtype=I32), AX),
                     suspected=lax.psum(numfailed.sum(dtype=I32), AX),
                     joins=out.join_ids,
                     removals=out.rm_ids,
-                    detections=lax.psum(
-                        agg.det_count.sum(dtype=I32)
-                        - state.agg.det_count.sum(dtype=I32), AX),
+                    detections=lax.psum(det_local, AX),
                     msgs_sent=out.sent,
                     msgs_recv=out.recv,
-                    dropped=lax.psum(sum(telem_dropped, zero), AX),
+                    dropped=dropped_g,
                     probe_acks=lax.psum(ack_recv_cnt.sum(dtype=I32), AX),
                     gossip_rows=lax.psum(sent_gossip.sum(dtype=I32), AX))
+                if cfg.telemetry_hist:
+                    # Local partial histograms psum'd per field (the
+                    # count reductions are linear); the log2 drop bucket
+                    # is not, so it takes the GLOBAL dropped scalar.
+                    hist = build_tick_hist(
+                        difft=difft, present=present, size=size,
+                        act=act, t=t, fail_time=fail_time,
+                        tfail=cfg.tfail, det_tick=det_local,
+                        dropped=dropped_g,
+                        psum=lambda v: lax.psum(v, AX))
+                    return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
 

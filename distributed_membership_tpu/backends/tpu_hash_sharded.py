@@ -77,7 +77,8 @@ from distributed_membership_tpu.eventlog import EventLog
 from distributed_membership_tpu.observability.aggregates import (
     AggStats, FastAgg, init_agg, init_fast_agg, update_agg, update_fast_agg)
 from distributed_membership_tpu.observability.timeline import (
-    PHASE_ACK, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry, telemetry_spec)
+    PHASE_ACK, PHASE_PROBE, PHASE_TELEMETRY, TickTelemetry,
+    build_tick_hist, hist_spec, telemetry_spec)
 from distributed_membership_tpu.ops.fused_receive import (
     receive_core, receive_fused)
 from distributed_membership_tpu.ops.sampling import sample_k_indices
@@ -889,6 +890,10 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             # mode, where agg passes through untouched).
             with jax.named_scope(PHASE_TELEMETRY):
                 zero = jnp.zeros((), I32)
+                det_local = (agg.det_count.sum(dtype=I32)
+                             - state.agg.det_count.sum(dtype=I32)
+                             if not cfg.collect_events else zero)
+                dropped_g = lax.psum(sum(telem_dropped, zero), AX)
                 telem = TickTelemetry(
                     live=lax.psum(act.sum(dtype=I32), AX),
                     suspected=lax.psum(numfailed.sum(dtype=I32), AX),
@@ -896,16 +901,25 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                         (join_ids != EMPTY).sum(dtype=I32), AX),
                     removals=lax.psum(
                         (rm_ids != EMPTY).sum(dtype=I32), AX),
-                    detections=lax.psum(
-                        agg.det_count.sum(dtype=I32)
-                        - state.agg.det_count.sum(dtype=I32), AX),
+                    detections=lax.psum(det_local, AX),
                     msgs_sent=lax.psum(sent_tick.sum(dtype=I32), AX),
                     msgs_recv=lax.psum(recv_tick.sum(dtype=I32), AX),
-                    dropped=lax.psum(sum(telem_dropped, zero), AX),
+                    dropped=dropped_g,
                     probe_acks=lax.psum(
                         ack_recv_cnt.sum(dtype=I32), AX),
                     gossip_rows=lax.psum(
                         sent_gossip.sum(dtype=I32), AX))
+                if cfg.telemetry_hist:
+                    # Local partial histograms psum'd per field (linear
+                    # reductions); the log2 drop bucket takes the GLOBAL
+                    # dropped scalar (observability/timeline.py).
+                    hist = build_tick_hist(
+                        difft=difft, present=present, size=size,
+                        act=act, t=t, fail_time=fail_time,
+                        tfail=cfg.tfail, det_tick=det_local,
+                        dropped=dropped_g,
+                        psum=lambda v: lax.psum(v, AX))
+                    return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
 
@@ -1339,7 +1353,11 @@ def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     if cfg.telemetry:
         # The per-tick outputs become (events, TickTelemetry) — every
         # telemetry field is a replicated scalar (psum'd in-step).
-        out_spec = (out_spec, telemetry_spec(P(None)))
+        # Under the hist tier the telemetry slot is a (scalars, hists)
+        # pair: each histogram is a replicated [B] vector.
+        tspec = telemetry_spec(P(None))
+        out_spec = (out_spec, ((tspec, hist_spec(P(None)))
+                               if cfg.telemetry_hist else tspec))
     return step, init, state_spec, out_spec, AX
 
 

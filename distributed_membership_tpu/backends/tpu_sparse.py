@@ -587,12 +587,13 @@ def finish_run(params: Params, plan: FailurePlan, log: EventLog,
     aggregate = params.resolved_event_mode() == "agg"
     kw = {}
     recorder = None
-    if params.TELEMETRY == "scalars":
+    if params.TELEMETRY in ("scalars", "hist"):
         # Flight recorder (observability/timeline.py): only the ring
         # backends get here (config.validate gates the knob), and their
         # run_scan accepts the recorder.  Series land in
         # extra['timeline']; TELEMETRY_DIR additionally streams
-        # timeline.jsonl per segment boundary.
+        # timeline.jsonl per segment boundary.  The hist tier rides the
+        # same recorder — its records just gain the [K][B] bucket lists.
         from distributed_membership_tpu.observability.timeline import (
             TimelineRecorder)
         recorder = TimelineRecorder(params.TELEMETRY_DIR or None)

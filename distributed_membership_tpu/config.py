@@ -246,7 +246,14 @@ class Params:
     # boundary.  Trajectory-inert by construction (no RNG consumed, no
     # state touched — bit-exactness pinned in tests/test_timeline.py)
     # and structurally free when 'off' (the default program is op-count
-    # identical — tests/test_hlo_census.py).  Ring exchange only; the
+    # identical — tests/test_hlo_census.py).  'hist' adds the
+    # distribution tier on top: per-tick fixed-bucket histograms
+    # (heartbeat staleness, suspicion age, detection latency, view
+    # occupancy, drop counts — bucket edges in
+    # observability/timeline.py) computed in-graph as bucketed one-hot
+    # reductions — still no RNG/gathers/scatters (census-pinned), still
+    # trajectory-inert, feeding the detection-latency SLO report
+    # (scripts/run_report.py --slo).  Ring exchange only; the
     # scatter/emul paths reject the knob loudly.
     TELEMETRY: str = "off"
     # Directory for the flight-recorder artifacts: timeline.jsonl
@@ -396,23 +403,25 @@ class Params:
                 raise ValueError(
                     "RNG_MODE hoisted requires the ring exchange (the "
                     "scatter lowering keeps its site-local draws)")
-        if self.TELEMETRY not in ("off", "scalars"):
+        if self.TELEMETRY not in ("off", "scalars", "hist"):
             raise ValueError(
-                f"TELEMETRY must be off|scalars, got {self.TELEMETRY!r}")
-        if self.TELEMETRY == "scalars":
+                f"TELEMETRY must be off|scalars|hist, got "
+                f"{self.TELEMETRY!r}")
+        if self.TELEMETRY in ("scalars", "hist"):
             # Loud-rejection policy (as PROBE_IO approx_lag / SHIFT_SET):
-            # only the ring steps emit the per-tick scalars — silently
+            # only the ring steps emit the per-tick series — silently
             # accepting the knob elsewhere would hand back an empty
             # timeline while claiming flight-recorder coverage.
             if self.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
                 raise ValueError(
-                    "TELEMETRY scalars is implemented by the ring "
-                    "backends only (tpu_hash, tpu_hash_sharded; got "
-                    f"BACKEND {self.BACKEND!r})")
+                    f"TELEMETRY {self.TELEMETRY} is implemented by the "
+                    "ring backends only (tpu_hash, tpu_hash_sharded; "
+                    f"got BACKEND {self.BACKEND!r})")
             if self.resolved_exchange() != "ring":
                 raise ValueError(
-                    "TELEMETRY scalars requires the ring exchange (the "
-                    "scatter lowering keeps the default program)")
+                    f"TELEMETRY {self.TELEMETRY} requires the ring "
+                    "exchange (the scatter lowering keeps the default "
+                    "program)")
         if self.PROBE_GATHER not in ("packed", "split"):
             raise ValueError(
                 f"PROBE_GATHER must be packed|split, got "

@@ -1,0 +1,272 @@
+"""Perf ledger: one append-only JSONL bank for every throughput number.
+
+The repo measures performance in four disconnected places — ``bench.py``
+legs (``BENCH_r*.json`` at the repo root), the multichip dry-run
+(``MULTICHIP_r*.json``), the TPU ladder (``artifacts/TPU_PROFILE.json``)
+and the scale smoke (``artifacts/SCALE_SMOKE.json``) — each with its own
+schema and no cross-run memory: a rung that silently lost 30% between
+two sessions is invisible unless someone diffs JSON by hand.  The ledger
+normalizes all of them into one row shape, keyed by
+
+    (rung, n, s, backend, platform, knobs_digest)
+
+where ``knobs_digest`` is a stable hash of the remaining run-identity
+knobs (mode, exchange, timing, mesh, ...), so rows are comparable iff
+they measured the same configuration.  Rows append to
+``artifacts/perf_ledger.jsonl``; ingestion is idempotent (a row identical
+up to ingestion timestamp is skipped), writes are single-line appends
+(same torn-tolerance contract as the other JSONL artifacts — the reader
+skips damaged lines).
+
+:func:`check` is the regression tripwire ``scripts/perf_ledger.py
+--check`` and the bench/ladder wiring call: within each key group it
+compares every row against the best earlier row and flags drops beyond
+a noise band (default :data:`DEFAULT_NOISE_BAND` — container-CPU timing
+noise between sessions is real, so the band is generous; the ladder's
+own retry logic handles finer-grained regressions within a session).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Dict, Iterable, List, Optional
+
+LEDGER_PATH = os.path.join("artifacts", "perf_ledger.jsonl")
+
+# Fractional drop vs the best banked row for the same key before a row
+# counts as a regression.  Higher-is-better metrics only (throughput);
+# lower-is-better metrics are stored with ``higher_is_better: False``.
+DEFAULT_NOISE_BAND = 0.30
+
+# Row fields that define identity for idempotent re-ingestion (the
+# ingestion timestamp deliberately excluded).
+_IDENTITY_FIELDS = ("key", "metric", "value", "source", "timestamp")
+
+
+def knobs_digest(knobs: Optional[dict]) -> str:
+    blob = json.dumps(knobs or {}, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def make_row(rung: str, *, metric: str, value: float,
+             n: Optional[int] = None, s: Optional[int] = None,
+             backend: Optional[str] = None, platform: Optional[str] = None,
+             knobs: Optional[dict] = None, source: Optional[str] = None,
+             timestamp: Optional[str] = None,
+             higher_is_better: bool = True) -> dict:
+    knobs = dict(knobs or {})
+    digest = knobs_digest(knobs)
+    key = "|".join([rung, str(n), str(s), str(backend), str(platform),
+                    metric, digest])
+    return {
+        "key": key, "rung": rung, "n": n, "s": s, "backend": backend,
+        "platform": platform, "knobs": knobs, "knobs_digest": digest,
+        "metric": metric, "value": float(value),
+        "higher_is_better": bool(higher_is_better),
+        "source": source, "timestamp": timestamp,
+        "ingested_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def load_ledger(path: str = LEDGER_PATH) -> List[dict]:
+    """All ledger rows, oldest first; torn/non-JSON lines skipped."""
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "key" in rec and "value" in rec:
+                rows.append(rec)
+    return rows
+
+
+def append_rows(rows: Iterable[dict], path: str = LEDGER_PATH) -> int:
+    """Append rows not already banked (identity up to ingestion time);
+    returns how many were actually written."""
+    existing = {tuple(r.get(f) for f in _IDENTITY_FIELDS)
+                for r in load_ledger(path)}
+    fresh = [r for r in rows
+             if tuple(r.get(f) for f in _IDENTITY_FIELDS) not in existing]
+    if fresh:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as fh:
+            for r in fresh:
+                fh.write(json.dumps(r, sort_keys=True) + "\n")
+    return len(fresh)
+
+
+def check(rows: List[dict],
+          band: float = DEFAULT_NOISE_BAND) -> List[dict]:
+    """Regressions: rows whose value dropped more than ``band`` below the
+    best earlier row of the same key (or rose above it, for
+    lower-is-better metrics).  Returns one record per offending row."""
+    best: Dict[str, dict] = {}
+    out = []
+    for row in rows:
+        key = row["key"]
+        prior = best.get(key)
+        if prior is not None:
+            hib = row.get("higher_is_better", True)
+            ref = prior["value"]
+            val = row["value"]
+            if ref > 0:
+                drop = (ref - val) / ref if hib else (val - ref) / ref
+                if drop > band:
+                    out.append({
+                        "key": key, "rung": row.get("rung"),
+                        "metric": row.get("metric"),
+                        "best": ref, "value": val,
+                        "drop_pct": round(drop * 100, 1),
+                        "band_pct": round(band * 100, 1),
+                        "source": row.get("source"),
+                    })
+        if (prior is None
+                or (row["value"] > prior["value"]) == row.get(
+                    "higher_is_better", True)):
+            best[key] = row
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Collectors: one per producer artifact family.
+
+_BENCH_NS_RE = re.compile(r"N=(\d+)(?:, S=(\d+))?")
+_BENCH_BACKEND_RE = re.compile(r"\((\w+) N=")
+_MULTICHIP_RE = re.compile(r"mesh=(\d+) nodes=(\d+)")
+
+
+def rows_from_bench(doc: dict, source: str) -> List[dict]:
+    """BENCH_r*.json: headline parsed metric + the dense/live_cpu/
+    hash_alt/hist side legs bench.py banks alongside it."""
+    rows: List[dict] = []
+    if doc.get("rc") not in (0, None):
+        return rows
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict):
+        return rows
+    metric_str = str(parsed.get("metric", ""))
+    m = _BENCH_NS_RE.search(metric_str)
+    n = int(m.group(1)) if m else None
+    s = int(m.group(2)) if m and m.group(2) else None
+    bk = _BENCH_BACKEND_RE.search(metric_str)
+    if parsed.get("value") is not None:
+        rows.append(make_row(
+            "bench:headline", metric="node_ticks_per_sec",
+            value=parsed["value"], n=n, s=s,
+            backend=bk.group(1) if bk else None,
+            platform=parsed.get("platform"),
+            knobs={"timing": parsed.get("timing"),
+                   "mode": parsed.get("mode"),
+                   "unit": parsed.get("unit")},
+            source=source))
+    for leg in ("dense", "live_cpu", "hash_alt", "hist"):
+        sub = parsed.get(leg)
+        if not isinstance(sub, dict):
+            continue
+        if sub.get("node_ticks_per_sec") is None:
+            continue
+        rows.append(make_row(
+            f"bench:{leg}", metric="node_ticks_per_sec",
+            value=sub["node_ticks_per_sec"],
+            n=sub.get("n"), s=sub.get("view_size"),
+            backend=sub.get("leg") if leg == "dense" else "tpu_hash",
+            platform=sub.get("platform", "cpu"),
+            knobs={k: sub.get(k) for k in ("ticks", "exchange", "mode")
+                   if sub.get(k) is not None},
+            source=source))
+    return rows
+
+
+def rows_from_multichip(doc: dict, source: str) -> List[dict]:
+    if doc.get("skipped"):
+        return []
+    m = _MULTICHIP_RE.search(str(doc.get("tail", "")))
+    return [make_row(
+        "multichip:dryrun", metric="ok",
+        value=1.0 if doc.get("ok") else 0.0,
+        n=int(m.group(2)) if m else None,
+        platform="multichip",
+        knobs={"mesh": int(m.group(1)) if m else None},
+        source=source)]
+
+
+def rows_from_tpu_profile(records: List[dict], source: str) -> List[dict]:
+    rows = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("node_ticks_per_sec") is None:
+            continue
+        rows.append(make_row(
+            f"ladder:{rec.get('rung')}", metric="node_ticks_per_sec",
+            value=rec["node_ticks_per_sec"],
+            n=rec.get("n"), s=rec.get("s"),
+            backend=rec.get("backend"), platform=rec.get("platform"),
+            knobs={k: rec.get(k) for k in ("timing", "mode", "exchange")
+                   if rec.get(k) is not None},
+            source=source, timestamp=rec.get("timestamp")))
+    return rows
+
+
+def rows_from_scale_smoke(records: List[dict], source: str) -> List[dict]:
+    rows = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("node_ticks_per_sec") is None:
+            continue
+        rows.append(make_row(
+            f"scale_smoke:{rec.get('n')}_s{rec.get('view_size')}",
+            metric="node_ticks_per_sec",
+            value=rec["node_ticks_per_sec"],
+            n=rec.get("n"), s=rec.get("view_size"),
+            backend=rec.get("backend"), platform=rec.get("platform"),
+            knobs={k: rec.get(k) for k in
+                   ("mesh_size", "ticks", "probes", "fanout")
+                   if rec.get(k) is not None},
+            source=source, timestamp=rec.get("timestamp")))
+    return rows
+
+
+def collect_all(root: str = ".") -> List[dict]:
+    """Every banked perf row discoverable under ``root`` (repo layout:
+    BENCH/MULTICHIP at the root, profiles under artifacts/)."""
+    rows: List[dict] = []
+
+    def _load(path):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if re.fullmatch(r"BENCH_r\d+\.json", name):
+            doc = _load(full)
+            if isinstance(doc, dict):
+                rows.extend(rows_from_bench(doc, name))
+        elif re.fullmatch(r"MULTICHIP_r\d+\.json", name):
+            doc = _load(full)
+            if isinstance(doc, dict):
+                rows.extend(rows_from_multichip(doc, name))
+    for name, fn in (("TPU_PROFILE.json", rows_from_tpu_profile),
+                     ("SCALE_SMOKE.json", rows_from_scale_smoke)):
+        full = os.path.join(root, "artifacts", name)
+        doc = _load(full)
+        if isinstance(doc, list):
+            rows.extend(fn(doc, os.path.join("artifacts", name)))
+    return rows

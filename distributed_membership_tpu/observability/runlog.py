@@ -112,5 +112,26 @@ def read_events(path: str, kinds=None,
 def maybe_runlog(directory: Optional[str],
                  name: str = "runlog.jsonl") -> Optional[RunLog]:
     """A RunLog under ``directory`` when one is configured, else None —
-    the one-liner chunked_run and the drivers gate their emission on."""
-    return RunLog(os.path.join(directory, name)) if directory else None
+    the one-liner chunked_run and the drivers gate their emission on.
+
+    ``DM_RUNLOG_MAX_BYTES`` overrides the rotation threshold for every
+    log built here (an env knob rather than a conf key: rotation is a
+    host-side durability concern, not part of run identity — the same
+    class as DM_CRASH_AT_TICK).  ``0`` disables rotation (unbounded);
+    unset/invalid keeps the 4 MiB default.  Rotation preserves the
+    reader contracts either way: :func:`read_events` walks the rotated
+    generations oldest-first and skips torn lines, so last-t0-wins
+    merging over the surviving window is unchanged."""
+    if not directory:
+        return None
+    max_bytes = 4 << 20
+    env = os.environ.get("DM_RUNLOG_MAX_BYTES", "")
+    if env:
+        try:
+            v = int(env)
+            # 0 = unbounded (a threshold no append reaches); negative
+            # or unparsable values keep the default.
+            max_bytes = (1 << 62) if v == 0 else v if v > 0 else max_bytes
+        except ValueError:
+            pass
+    return RunLog(os.path.join(directory, name), max_bytes=max_bytes)

@@ -35,6 +35,37 @@ Field semantics (all int32 scalars per tick):
   * ``probe_acks``  — ack messages applied by the probe pipeline this tick;
   * ``gossip_rows`` — view entries carried by gossip payloads this tick.
 
+The histogram tier (``TELEMETRY: hist``) layers the distributional
+quantities the scalars cannot carry on top of the same pipeline: each
+tick additionally emits a :class:`TickHist` of fixed-bucket int32
+histograms computed in-graph as nibble-packed masked reductions over
+tensors the step already holds — no gathers, no scatters, no RNG (the
+census test pins this), just compares/shifts summed over the state axes
+(see :func:`hist_bucket_counts` for the packing), so
+the hist program stays trajectory-inert and fold/shard-invariant (a
+fold is a reshape and each reduction is linear, so per-shard partials
+psum to the global counts bit-exactly).  Bucket edges are static
+(``HIST_BUCKETS`` / ``HIST_EDGES`` below):
+
+  * ``h_staleness``  — heartbeat staleness ``t - view_ts`` of present
+    view entries; 8 buckets x 8 ticks (last = overflow >= 56);
+  * ``h_suspicion``  — suspicion age ``staleness - TFAIL`` of entries
+    past TFAIL; 8 buckets x 8 ticks (last = overflow);
+  * ``h_latency``    — detection latency ``t - fail_time`` at each TRUE
+    detection this tick; 64 UNIT buckets (last = overflow >= 63) — unit
+    width makes the reconstructed removal-latency distribution EXACT,
+    the property the SLO report (observability/latency_dist.py) and the
+    N=10 eventlog-match test rely on;
+  * ``h_occupancy``  — per-node view occupancy (live nodes only);
+    16 unit buckets (last = overflow >= 15);
+  * ``h_drops``      — the tick's total dropped-message count on a log2
+    scale: bucket 0 = no drops, bucket k = [2^(k-1), 2^k), 16 buckets.
+
+The series ride the scan outputs exactly like the scalars ([K, B] per
+``CHECKPOINT_EVERY`` segment), flush into the same torn-tolerant
+``timeline.jsonl`` (records gain nested ``[K][B]`` lists), and merge
+last-t0-wins across kill/resume.
+
 Part 2 of the recorder is phase-scoped tracing: the protocol phases are
 wrapped in ``jax.named_scope`` (names below) across all four ring twins
 and the fused kernels, so a ``jax.profiler`` capture
@@ -86,14 +117,145 @@ class TickTelemetry(NamedTuple):
     gossip_rows: object
 
 
+class TickHist(NamedTuple):
+    """One tick's fixed-bucket histograms (module docstring for bucket
+    semantics).  Inside the scan each field is a [B] int32 vector;
+    stacked by the scan they become [K, B] per-segment series."""
+    h_staleness: object
+    h_suspicion: object
+    h_latency: object
+    h_occupancy: object
+    h_drops: object
+
+
 TELEMETRY_FIELDS = TickTelemetry._fields
+HIST_FIELDS = TickHist._fields
 TIMELINE_NAME = "timeline.jsonl"
+
+# Static bucket geometry (documented in the module docstring; README
+# "Observability").  Changing these changes the timeline.jsonl schema —
+# consumers read bucket counts positionally.
+HIST_BUCKETS = {"h_staleness": 8, "h_suspicion": 8, "h_latency": 64,
+                "h_occupancy": 16, "h_drops": 16}
+STALENESS_BUCKET_TICKS = 8      # h_staleness / h_suspicion bucket width
+LATENCY_BUCKETS = HIST_BUCKETS["h_latency"]
 
 
 def telemetry_spec(p):
     """A TickTelemetry of identical (sharding/shape) specs — the sharded
     backend's out_specs entry (every field is a replicated scalar)."""
     return TickTelemetry(*(p for _ in TELEMETRY_FIELDS))
+
+
+def hist_spec(p):
+    """A TickHist of identical specs (every histogram is a replicated
+    [B] vector after the in-step psum) — the sharded backend's
+    out_specs entry for the hist tier."""
+    return TickHist(*(p for _ in HIST_FIELDS))
+
+
+# ---------------------------------------------------------------------------
+# In-graph histogram builders (shared by all four ring twins).
+#
+# Everything here is reductions + bounded elementwise: per static bucket
+# index, a masked compare summed over the state axes.  No gathers, no
+# scatters, no RNG — tests/test_hlo_census.py pins that structural
+# contract at the [1M, 16] geometry.  jax is imported lazily so the
+# pure-numpy readers below stay importable without it.
+
+def hist_bucket_counts(vals, mask, nbins: int, width: int):
+    """[nbins] int32 bucket counts of ``vals`` (int) under ``mask``:
+    bucket ``b`` counts masked elements with ``vals // width == b``,
+    clipped into [0, nbins-1] (last bucket = overflow).  Works on any
+    shape — natural [N, S], folded planes, or [N] vectors — and a fold
+    is a reshape, so folded counts are bit-equal to natural ones: the
+    histogram only sees the element multiset and integer sums are
+    order-free.
+
+    The large-tensor path is a nibble-packed two-stage reduction, not a
+    per-bucket compare-and-reduce and not an [..., nbins] one-hot
+    expansion: XLA:CPU fuses neither into a single pass, so at
+    [65536, 16] the expansion costs ~8 full-tensor passes' bandwidth
+    (measured 22.9 ms) and the unrolled compares one pass PER BUCKET
+    (5.7 ms; ~20% step overhead against a ~5% budget).  Instead the
+    tensor is reshaped into rows of 8, each masked element contributes
+    ``1 << 4*id`` so a single row-sum packs eight per-row bucket counts
+    into one int32 (counts <= 8 per 4-bit field — no carries; the top
+    field's wrap past the sign bit is benign because decoding only
+    reinterprets bits), and the 8 scalar counts decode from the 8x
+    smaller packed vector.  Two full-tensor passes replace sixteen for
+    the staleness + suspicion pair.  Tiny or non-divisible tensors keep
+    the unrolled form; both forms count identically."""
+    import jax.numpy as jnp
+
+    ids = jnp.clip(vals // width if width > 1 else vals, 0, nbins - 1)
+    total = 1
+    for d in ids.shape:
+        total *= d
+    if total % 8 or total <= 1024:
+        return jnp.stack([((ids == b) & mask).sum(dtype=jnp.int32)
+                          for b in range(nbins)])
+    rows_i = ids.reshape(-1, 8).astype(jnp.int32)
+    rows_m = mask.reshape(-1, 8)
+    counts = []
+    for lo in range(0, nbins, 8):
+        in_chunk = rows_m & (rows_i >= lo) & (rows_i < lo + 8)
+        field = jnp.clip(rows_i - lo, 0, 7)   # shift stays in-range even
+        packed = jnp.where(in_chunk,          # where in_chunk is False
+                           jnp.int32(1) << (4 * field),
+                           0).sum(axis=1, dtype=jnp.int32)
+        counts.extend(((packed >> (4 * b)) & 0xF).sum(dtype=jnp.int32)
+                      for b in range(min(8, nbins - lo)))
+    return jnp.stack(counts)
+
+
+def scalar_one_hot(idx, nbins: int, count):
+    """[nbins] int32 with ``count`` at ``clip(idx, 0, nbins-1)`` — the
+    free histogram of a quantity that is a single scalar this tick
+    (detection latency: every detection at tick t shares t - fail_time)."""
+    import jax.numpy as jnp
+
+    where = jnp.clip(idx, 0, nbins - 1)
+    return ((jnp.arange(nbins) == where).astype(jnp.int32)
+            * count.astype(jnp.int32))
+
+
+def drops_hist(dropped, nbins: int = HIST_BUCKETS["h_drops"]):
+    """[nbins] int32 log2 one-hot of the tick's total drop count:
+    bucket 0 = zero drops, bucket k = [2^(k-1), 2^k) (last = overflow).
+    The log index is a static unrolled compare chain — no float log, no
+    data-dependent control flow."""
+    import jax.numpy as jnp
+
+    idx = sum((dropped >= (1 << i)).astype(jnp.int32)
+              for i in range(nbins - 1))
+    return (jnp.arange(nbins) == idx).astype(jnp.int32)
+
+
+def build_tick_hist(*, difft, present, size, act, t, fail_time, tfail,
+                    det_tick, dropped, psum=None):
+    """The TickHist every ring twin emits, from tensors the step already
+    holds: ``difft``/``present`` are the post-receive staleness planes
+    ([N, S] natural or [N*S/128, 128] folded), ``size``/``act`` the
+    per-node occupancy and liveness vectors, ``det_tick`` this tick's
+    TRUE-detection count and ``dropped`` its drop count.  On the sharded
+    twins pass the LOCAL tensors plus ``psum`` (the axis reducer) and
+    the GLOBAL ``dropped`` scalar — the four count histograms are linear
+    so per-shard partials psum exactly; the log2 drop bucket is not, so
+    it must be computed after the merge."""
+    stale = hist_bucket_counts(difft, present,
+                               HIST_BUCKETS["h_staleness"],
+                               STALENESS_BUCKET_TICKS)
+    susp = hist_bucket_counts(difft - tfail, present & (difft >= tfail),
+                              HIST_BUCKETS["h_suspicion"],
+                              STALENESS_BUCKET_TICKS)
+    occ = hist_bucket_counts(size, act, HIST_BUCKETS["h_occupancy"], 1)
+    lat = scalar_one_hot(t - fail_time, LATENCY_BUCKETS, det_tick)
+    if psum is not None:
+        stale, susp, occ, lat = (psum(stale), psum(susp), psum(occ),
+                                 psum(lat))
+    return TickHist(h_staleness=stale, h_suspicion=susp, h_latency=lat,
+                    h_occupancy=occ, h_drops=drops_hist(dropped))
 
 
 class TimelineRecorder:
@@ -117,13 +279,26 @@ class TimelineRecorder:
         self._chunks: list = []      # [(t0, {field: np.ndarray[K]})]
 
     def flush(self, telem, t0: int) -> None:
-        """Bank one segment's [K]-shaped series starting at tick ``t0``."""
+        """Bank one segment's [K]-shaped series starting at tick ``t0``.
+
+        ``telem`` is either a TickTelemetry of [K] series (TELEMETRY:
+        scalars) or a ``(TickTelemetry, TickHist)`` pair (TELEMETRY:
+        hist) whose hist fields are [K, B] series — the hist records
+        carry nested ``[K][B]`` lists in the same JSONL line."""
+        hist = None
+        if type(telem) is tuple:          # (scalars, hist) — the hist tier
+            telem, hist = telem
         rec = {f: np.asarray(getattr(telem, f)).astype(np.int64).reshape(-1)
                for f in TELEMETRY_FIELDS}
+        if hist is not None:
+            k = len(rec["live"])
+            rec.update({f: np.asarray(getattr(hist, f))
+                        .astype(np.int64).reshape(k, -1)
+                        for f in HIST_FIELDS})
         self._chunks.append((int(t0), rec))
         if self.path:
             line = {"t0": int(t0), "ticks": int(len(rec["live"]))}
-            line.update({f: rec[f].tolist() for f in TELEMETRY_FIELDS})
+            line.update({f: rec[f].tolist() for f in rec})
             with open(self.path, "a") as fh:
                 fh.write(json.dumps(line) + "\n")
 
@@ -146,8 +321,14 @@ def _merge_chunks(chunks) -> dict:
         out.update(t0=0, ticks=0, detections_cum=np.zeros((0,), np.int64))
         return out
     t0s = sorted(dedup)
+    # Hist fields are only present on hist-tier records; a field merges
+    # only when EVERY surviving chunk carries it (mixed-tier files keep
+    # the scalar series intact rather than producing ragged hist ones).
+    fields = set(dedup[t0s[0]])
+    for t in t0s[1:]:
+        fields &= set(dedup[t])
     out = {f: np.concatenate([dedup[t][f] for t in t0s])
-           for f in TELEMETRY_FIELDS}
+           for f in fields}
     out["t0"] = t0s[0]
     out["ticks"] = int(sum(len(dedup[t]["live"]) for t in t0s))
     # ``detections`` is per-tick (delta) so it stays segment-local exact
@@ -174,7 +355,8 @@ def read_timeline(path: str) -> dict:
                 continue            # torn trailing write
             chunks.append((int(rec["t0"]),
                            {f: np.asarray(rec[f], np.int64)
-                            for f in TELEMETRY_FIELDS}))
+                            for f in TELEMETRY_FIELDS + HIST_FIELDS
+                            if f in rec}))
     return _merge_chunks(chunks)
 
 
@@ -184,7 +366,25 @@ def timeline_summary(series: dict) -> dict:
         return {"ticks": 0}
     det = series["detections"]
     det_ticks = np.nonzero(det)[0]
+    hist_extra = {}
+    if "h_latency" in series:
+        # Hist-tier cross-check totals: the latency histogram's mass is
+        # exactly the detections series (both count TRUE detections), so
+        # any divergence means a torn artifact set (run_report and the
+        # scenario oracle reconcile on this).
+        hist_extra = {
+            "hist": True,
+            "latency_hist_detections": int(series["h_latency"].sum()),
+            "occupancy_mean": (
+                round(float((series["h_occupancy"]
+                             * np.arange(series["h_occupancy"].shape[1])
+                             ).sum())
+                      / max(int(series["h_occupancy"].sum()), 1), 2)),
+            "staleness_overflow_total": int(
+                series["h_staleness"][:, -1].sum()),
+        }
     return {
+        **hist_extra,
         "ticks": int(series["ticks"]),
         "t0": int(series["t0"]),
         "joins_total": int(series["joins"].sum()),

@@ -12,10 +12,20 @@ Renders the three recorder streams into a single report:
   * a ladder event log (``artifacts/ladder_events.jsonl``): per-rung
     start/land/fail/retry/resume provenance.
 
+With the hist telemetry tier (``TELEMETRY: hist``) two more views open:
+``--slo`` reconstructs the detection-latency distribution from the
+banked ``h_latency`` histograms and renders the BASELINE.md fidelity
+verdict (observability/latency_dist.py), dropping ``slo.json`` next to
+the timeline; ``--compare A B`` diffs two recorder directories series by
+series and reports the first diverging tick — the bisect primitive for
+"same run, different twin/resume/knob" investigations.
+
 Usage:
   python scripts/run_report.py --dir <TELEMETRY_DIR>            # markdown
   python scripts/run_report.py --dir <dir> --json               # dict
   python scripts/run_report.py --dir <dir> --out report.md
+  python scripts/run_report.py --dir <dir> --slo                # + verdict
+  python scripts/run_report.py --compare <dirA> <dirB>
   python scripts/run_report.py --ladder artifacts/ladder_events.jsonl
 """
 
@@ -30,10 +40,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from distributed_membership_tpu.observability.latency_dist import (  # noqa: E402
+    slo_verdict)
 from distributed_membership_tpu.observability.runlog import (  # noqa: E402
     read_events)
 from distributed_membership_tpu.observability.timeline import (  # noqa: E402
-    read_timeline, timeline_summary)
+    TIMELINE_NAME, read_timeline, timeline_summary)
 
 
 def _segment_stats(events: list) -> dict:
@@ -106,11 +118,17 @@ def _ladder_stats(events: list) -> dict:
 
 
 def build_report(directory: str | None,
-                 ladder_path: str | None = None) -> dict:
-    """Collect every recorder stream present into one dict."""
+                 ladder_path: str | None = None,
+                 slo: bool = False) -> dict:
+    """Collect every recorder stream present into one dict.
+
+    ``slo=True`` adds the detection-latency SLO verdict reconstructed
+    from the hist tier's ``h_latency`` series (and the caller writes it
+    to ``<directory>/slo.json``)."""
     report: dict = {}
+    series: dict = {}
     if directory:
-        tl_path = os.path.join(directory, "timeline.jsonl")
+        tl_path = os.path.join(directory, TIMELINE_NAME)
         if os.path.exists(tl_path):
             series = read_timeline(tl_path)
             report["timeline"] = timeline_summary(series)
@@ -152,7 +170,48 @@ def build_report(directory: str | None,
             "scenario_removals_match":
                 sc["totals"]["removals_total"] == tl["removals_total"],
         })
+    # Hist ↔ scalars cross-check: the latency histogram's total mass is
+    # the per-tick detections series re-counted through a different
+    # in-graph reduction — they must agree tick-for-tick in aggregate.
+    if tl and tl.get("hist"):
+        report.setdefault("reconciliation", {})
+        report["reconciliation"]["hist_latency_matches_detections"] = (
+            tl["latency_hist_detections"] == tl["detections_total"])
+    if slo and "h_latency" in series:
+        report["slo"] = slo_verdict(series)
     return report
+
+
+def compare_dirs(dir_a: str, dir_b: str) -> dict:
+    """Series-by-series diff of two recorder directories: per common
+    series, the first tick where the values diverge (hist series compare
+    whole bucket rows), plus length mismatches and one-sided fields.
+    ``identical`` is the roll-up verdict."""
+    def _arrays(d):
+        return {f: v for f, v in d.items() if getattr(v, "ndim", None)}
+
+    out: dict = {"a": dir_a, "b": dir_b, "series": {}, "identical": True}
+    sa = _arrays(read_timeline(os.path.join(dir_a, TIMELINE_NAME)))
+    sb = _arrays(read_timeline(os.path.join(dir_b, TIMELINE_NAME)))
+    out["only_in_a"] = sorted(set(sa) - set(sb))
+    out["only_in_b"] = sorted(set(sb) - set(sa))
+    if out["only_in_a"] or out["only_in_b"]:
+        out["identical"] = False
+    for f in sorted(set(sa) & set(sb)):
+        va, vb = sa[f], sb[f]
+        k = min(len(va), len(vb))
+        neq = va[:k] != vb[:k]
+        if neq.ndim > 1:
+            neq = neq.any(axis=tuple(range(1, neq.ndim)))
+        idx = neq.nonzero()[0]
+        first = int(idx[0]) if len(idx) else None
+        entry = {"ticks_a": int(len(va)), "ticks_b": int(len(vb)),
+                 "first_divergence": first,
+                 "diverging_ticks": int(len(idx))}
+        if first is not None or len(va) != len(vb):
+            out["identical"] = False
+        out["series"][f] = entry
+    return out
 
 
 def _scenario_markers(sc: dict) -> list:
@@ -213,6 +272,21 @@ def render_markdown(report: dict) -> str:
         lines += _md_kv({k: v for k, v in ds.items()
                          if not isinstance(v, dict)})
         lines.append("")
+    slo = report.get("slo")
+    if slo:
+        verdict = ("PASS" if slo["passed"] else
+                   "no data" if slo["passed"] is None else "FAIL")
+        lines += ["## Detection-latency SLO", "",
+                  f"**{verdict}** — max CDF deviation "
+                  f"{slo['max_cdf_deviation']:.4f} vs threshold "
+                  f"{slo['threshold']:.2f} "
+                  f"({slo['detections_total']} detections)", "",
+                  "| latency (ticks) | observed | reference |",
+                  "|---|---|---|"]
+        for k in sorted(set(slo["observed"]) | set(slo["reference"])):
+            lines.append(f"| {k} | {slo['observed'].get(k, 0)} | "
+                         f"{slo['reference'].get(k, 0)} |")
+        lines.append("")
     rc = report.get("reconciliation")
     if rc:
         lines += ["## Timeline ↔ summary reconciliation", "",
@@ -246,7 +320,24 @@ def render_markdown(report: dict) -> str:
     return "\n".join(lines)
 
 
-def main() -> int:
+def render_compare_markdown(cmp: dict) -> str:
+    lines = ["# Recorder compare", "",
+             f"- A: `{cmp['a']}`", f"- B: `{cmp['b']}`",
+             f"- identical: **{cmp['identical']}**", ""]
+    if cmp["only_in_a"]:
+        lines.append(f"- only in A: {', '.join(cmp['only_in_a'])}")
+    if cmp["only_in_b"]:
+        lines.append(f"- only in B: {', '.join(cmp['only_in_b'])}")
+    lines += ["", "| series | ticks A | ticks B | first divergence | "
+              "diverging ticks |", "|---|---|---|---|---|"]
+    for f, e in cmp["series"].items():
+        first = "—" if e["first_divergence"] is None else e["first_divergence"]
+        lines.append(f"| {f} | {e['ticks_a']} | {e['ticks_b']} | "
+                     f"{first} | {e['diverging_ticks']} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dir", default=None,
                     help="flight-recorder directory (TELEMETRY_DIR): "
@@ -260,7 +351,26 @@ def main() -> int:
     ap.add_argument("--out", default=None,
                     help="write the report to this file instead of "
                          "stdout")
-    args = ap.parse_args()
+    ap.add_argument("--slo", action="store_true",
+                    help="add the detection-latency SLO verdict "
+                         "(requires --dir with a hist-tier timeline); "
+                         "also writes <dir>/slo.json")
+    ap.add_argument("--compare", nargs=2, metavar=("DIR_A", "DIR_B"),
+                    default=None,
+                    help="diff two recorder directories series-by-series "
+                         "and report the first diverging tick")
+    args = ap.parse_args(argv)
+    if args.compare:
+        cmp = compare_dirs(*args.compare)
+        text = (json.dumps(cmp, indent=1) if args.json
+                else render_compare_markdown(cmp))
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text + "\n")
+            print(args.out)
+        else:
+            print(text)
+        return 0 if cmp["identical"] else 2
     if not args.dir and not args.ladder:
         default_ladder = os.path.join(REPO, "artifacts",
                                       "ladder_events.jsonl")
@@ -269,7 +379,15 @@ def main() -> int:
         else:
             ap.error("pass --dir and/or --ladder")
 
-    report = build_report(args.dir, args.ladder)
+    report = build_report(args.dir, args.ladder, slo=args.slo)
+    if args.slo:
+        if "slo" not in report:
+            print("run_report: --slo needs a hist-tier timeline "
+                  f"(TELEMETRY: hist) under {args.dir}", file=sys.stderr)
+            return 2
+        with open(os.path.join(args.dir, "slo.json"), "w") as fh:
+            json.dump(report["slo"], fh, indent=1)
+            fh.write("\n")
     text = (json.dumps(report, indent=1) if args.json
             else render_markdown(report))
     if args.out:

@@ -224,6 +224,29 @@ def append(rec: dict) -> None:
     with open(tmp, "w") as fh:
         json.dump(recs, fh, indent=1)
     os.replace(tmp, OUT)
+    _ledger_bank(rec)
+
+
+def _ledger_bank(rec: dict) -> None:
+    """Mirror a landed rung into artifacts/perf_ledger.jsonl and warn on
+    regressions vs banked history (observability/perfdb.py).  Telemetry
+    only — a ledger failure never blocks the ladder."""
+    try:
+        from distributed_membership_tpu.observability import perfdb
+        if rec.get("node_ticks_per_sec") is None:
+            return
+        # Anchored next to OUT so tests that redirect the profile to a
+        # tmp dir redirect the ledger with it (no repo side effects).
+        path = os.path.join(os.path.dirname(OUT),
+                            os.path.basename(perfdb.LEDGER_PATH))
+        perfdb.append_rows(perfdb.rows_from_tpu_profile(
+            [rec], "artifacts/TPU_PROFILE.json"), path)
+        for reg in perfdb.check(perfdb.load_ledger(path)):
+            print(f"  perf_ledger regression: {reg['rung']} "
+                  f"{reg['value']:.0f} vs best {reg['best']:.0f} "
+                  f"(-{reg['drop_pct']}%)", flush=True)
+    except Exception as e:
+        print(f"  perf ledger update failed: {e}", flush=True)
 
 
 def probe() -> str | None:

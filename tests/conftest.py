@@ -68,6 +68,22 @@ _QUICK = {
 }
 
 
+# Tier-1 wall-time audit (tests/test_zz_marker_audit.py, collected
+# last): every test's call-phase duration is recorded here, along with
+# which collected tests carry the `slow` marker, so the audit can fail
+# any unmarked test that exceeds the per-test budget — the guard that
+# keeps the `-m 'not slow'` tier inside its CI timeout as files grow.
+SLOW_BUDGET_ENV = "DM_SLOW_BUDGET_SECONDS"
+SLOW_BUDGET_DEFAULT = 60.0
+TEST_DURATIONS = {}         # nodeid -> call-phase seconds, this session
+SLOW_MARKED = set()         # nodeids of collected slow-marked tests
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        TEST_DURATIONS[report.nodeid] = report.duration
+
+
 def pytest_collection_modifyitems(config, items):
     seen = {}
     for item in items:
@@ -75,6 +91,8 @@ def pytest_collection_modifyitems(config, items):
         seen.setdefault(fname, set()).add(item.name)
         if fname in _QUICK_ALL or item.name in _QUICK.get(fname, ()):
             item.add_marker(pytest.mark.quick)
+        if item.get_closest_marker("slow"):
+            SLOW_MARKED.add(item.nodeid)
     # Tripwire: a renamed test (or changed parametrize id) must not
     # silently drop out of the quick tier.  Checked only against files
     # that actually collected, so single-file runs still work; a

@@ -79,6 +79,40 @@ def test_telemetry_off_is_op_count_identical_and_on_is_bounded():
 
 
 @pytest.mark.quick
+def test_hist_census_bounded_at_1m_s16():
+    """Histogram-tier structural contract at the [1M, 16] north-star
+    geometry (``TELEMETRY: hist``, observability/timeline.py
+    build_tick_hist): the off-path program stays OP-COUNT IDENTICAL
+    (the tier is opt-in), and the hist program adds ZERO threefry
+    invocations, zero new [N]-class gathers and zero new scatters over
+    the scalars tier — the histogram builders are nibble-packed
+    compare/shift/reduce chains (timeline.py hist_bucket_counts).
+    Their [N, S]-class additions (the staleness + suspicion pack
+    passes, their per-bucket decodes over the 8x-smaller packed
+    vector, and the occupancy plumbing) are pinned at the measured
+    count (+59 over scalars on both the drop-free and msgdrop-class
+    programs) with small slack."""
+    for drops in (False, True):
+        base = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops))
+        off = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops, telemetry="off"))
+        assert off == base, (off, base)
+
+        scalars = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops, telemetry="scalars"))
+        hist = hlo_census.step_census(hlo_census.census_params(
+            1 << 20, 16, drops=drops, telemetry="hist"))
+        for k in ("threefry_calls", "big_gathers", "big_gather_shapes",
+                  "big_scatters"):
+            assert hist[k] == base[k], (k, hist[k], base[k])
+        assert 0 <= (hist["ns_class_ops"]
+                     - scalars["ns_class_ops"]) <= 64, (
+            hist["ns_class_ops"], scalars["ns_class_ops"])
+        assert hist["total_eqns"] > scalars["total_eqns"]
+
+
+@pytest.mark.quick
 def test_scenario_census_bounded_at_1m_s16():
     """Scenario-engine structural contract at the [1M, 16] north-star
     geometry: with no scenario the program is OP-COUNT IDENTICAL to the

@@ -254,3 +254,42 @@ def test_run_report_renders_ladder_events(tmp_path):
     assert report["ladder"]["landed_total"] == 1
     md = run_report.render_markdown(report)
     assert "65k_s16" in md and "landed" in md and "failed" in md
+
+
+def test_maybe_runlog_max_bytes_env_knob(tmp_path, monkeypatch):
+    """DM_RUNLOG_MAX_BYTES tunes rotation without touching run identity:
+    a small threshold forces rotation, 0 disables it, and junk keeps the
+    default.  Rotation must preserve the reader contracts — torn lines
+    are skipped in every generation, and a last-write-wins consumer
+    (keyed replay, as run_report's segment dedup) still lands on the
+    newest record because read_events walks oldest-first."""
+    from distributed_membership_tpu.observability.runlog import (
+        maybe_runlog)
+
+    assert maybe_runlog(None) is None
+
+    monkeypatch.setenv("DM_RUNLOG_MAX_BYTES", "200")
+    log = maybe_runlog(str(tmp_path / "small"))
+    assert log.max_bytes == 200
+    for i in range(20):
+        log.event("segment", t0=i % 4, i=i)
+    assert os.path.exists(log.path + ".1")     # knob took effect
+    # Tear the CURRENT generation mid-line; rotated ones stay intact.
+    with open(log.path, "a") as fh:
+        fh.write('{"kind": "segment", "t0"')
+    events = read_events(log.path, kinds={"segment"})
+    assert all("i" in e for e in events)       # torn line skipped
+    # Oldest-first order => replaying into a dict keyed by t0 keeps the
+    # NEWEST record per key, across the rotation boundary.
+    last = {e["t0"]: e["i"] for e in events}
+    for t0, i in last.items():
+        assert i == max(e["i"] for e in events if e["t0"] == t0)
+    assert last[19 % 4] == 19
+
+    monkeypatch.setenv("DM_RUNLOG_MAX_BYTES", "0")
+    unbounded = maybe_runlog(str(tmp_path / "unbounded"))
+    assert unbounded.max_bytes == 1 << 62      # rotation disabled
+    monkeypatch.setenv("DM_RUNLOG_MAX_BYTES", "junk")
+    assert maybe_runlog(str(tmp_path / "junk")).max_bytes == 4 << 20
+    monkeypatch.setenv("DM_RUNLOG_MAX_BYTES", "-5")
+    assert maybe_runlog(str(tmp_path / "neg")).max_bytes == 4 << 20

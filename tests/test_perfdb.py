@@ -1,0 +1,111 @@
+"""Perf ledger (observability/perfdb.py + scripts/perf_ledger.py).
+
+The ledger is the cross-PR memory of every banked wall-clock number:
+append-only JSONL keyed by (rung, N, S, backend, platform, metric,
+knobs-digest), idempotent re-ingestion, and a direction-aware regression
+check against the best earlier row per key.  These tests pin the row
+identity/idempotency contract, the check's noise-band semantics on
+synthetic histories, and — the acceptance criterion — that the check is
+GREEN over every artifact actually banked in this repo.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_membership_tpu.observability import perfdb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.quick
+def test_make_row_key_and_digest():
+    r = perfdb.make_row("bench:live:hash", metric="node_ticks_per_sec",
+                        value=1000.0, n=65536, s=16, backend="tpu_hash",
+                        platform="cpu", knobs={"b": 2, "a": 1})
+    assert r["key"].startswith("bench:live:hash|65536|16|tpu_hash|cpu|"
+                               "node_ticks_per_sec|")
+    # Digest is canonical: knob insertion order doesn't change identity.
+    assert (perfdb.knobs_digest({"b": 2, "a": 1})
+            == perfdb.knobs_digest({"a": 1, "b": 2})
+            == r["knobs_digest"])
+    assert perfdb.knobs_digest(None) == perfdb.knobs_digest({})
+    assert r["higher_is_better"] is True and r["value"] == 1000.0
+
+
+@pytest.mark.quick
+def test_append_is_idempotent_and_torn_tolerant(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    rows = [perfdb.make_row("r", metric="m", value=v, source="s",
+                            timestamp="t") for v in (1.0, 2.0)]
+    assert perfdb.append_rows(rows, path) == 2
+    # Identical identity (key, metric, value, source, timestamp) rows
+    # are already banked — re-ingestion writes nothing, even though the
+    # ingested_at stamps differ.
+    assert perfdb.append_rows([dict(r, ingested_at="later")
+                               for r in rows], path) == 0
+    # A torn trailing line neither breaks the reader nor the dedupe.
+    with open(path, "a") as fh:
+        fh.write('{"key": "r|None|None|None|None|m|truncat')
+    assert len(perfdb.load_ledger(path)) == 2
+    assert perfdb.append_rows(rows, path) == 0
+    # A genuinely new measurement of the same key DOES append.
+    assert perfdb.append_rows(
+        [perfdb.make_row("r", metric="m", value=3.0, source="s2",
+                         timestamp="t2")], path) == 1
+
+
+@pytest.mark.quick
+def test_check_noise_band_and_direction():
+    def row(value, hib=True):
+        return perfdb.make_row("rung", metric="m", value=value,
+                               higher_is_better=hib, source="x")
+
+    # Within the 30% band: no flag.  Beyond it: flagged vs the BEST
+    # earlier row, not the previous one.
+    assert perfdb.check([row(100.0), row(80.0)]) == []
+    bad = perfdb.check([row(100.0), row(65.0)])
+    assert len(bad) == 1 and bad[0]["drop_pct"] == 35.0
+    # An improvement raises the bar; a later return to the old level
+    # then regresses against the improved best.
+    assert perfdb.check([row(100.0), row(200.0), row(130.0)])
+    assert perfdb.check([row(100.0), row(200.0), row(150.0)]) == []
+    # Lower-is-better metrics flag in the opposite direction.
+    assert perfdb.check([row(10.0, hib=False), row(14.0, hib=False)])
+    assert perfdb.check([row(10.0, hib=False), row(12.0, hib=False)]) == []
+    # A custom band widens tolerance.
+    assert perfdb.check([row(100.0), row(65.0)], band=0.5) == []
+
+
+@pytest.mark.quick
+def test_collectors_and_repo_artifacts_are_green():
+    """The acceptance pin: every artifact banked in this repo collects
+    into rows and the regression check passes over all of them."""
+    rows = perfdb.collect_all(REPO)
+    assert rows, "no banked artifacts found at the repo root"
+    rungs = {r["rung"] for r in rows}
+    assert any(r.startswith("bench:") for r in rungs)
+    assert any(r.startswith("ladder:") for r in rungs)
+    assert perfdb.check(rows) == []
+    # And the committed ledger itself replays green.
+    banked = perfdb.load_ledger(os.path.join(REPO, perfdb.LEDGER_PATH))
+    assert banked and perfdb.check(banked) == []
+
+
+@pytest.mark.quick
+def test_perf_ledger_cli_check_green(tmp_path):
+    """scripts/perf_ledger.py ingests into a fresh ledger idempotently
+    and exits 0 under --check over everything it banked."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "perf_ledger.py"),
+           "--root", REPO, "--ledger", ledger, "--check", "--json"]
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["rows_added"] > 0 and doc["regressions"] == []
+    again = subprocess.run(cmd, capture_output=True, text=True)
+    assert again.returncode == 0
+    assert json.loads(again.stdout)["rows_added"] == 0

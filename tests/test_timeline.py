@@ -109,6 +109,63 @@ def test_telemetry_rejected_off_ring():
         Params.from_text(
             "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
             "MSG_DROP_PROB: 0\nTELEMETRY: bogus\n")
+    # The hist tier rides the same gates (ring-only, ring backends).
+    with pytest.raises(ValueError, match="ring exchange"):
+        Params.from_text(_conf(False, "BACKEND: tpu_hash\n"
+                               "EXCHANGE: scatter\nTELEMETRY: hist\n"))
+
+
+# ---------------------------------------------------------------------------
+# Histogram tier: trajectory-inert, twin-invariant, scalar-consistent.
+
+@pytest.mark.quick
+@pytest.mark.parametrize("extra", [
+    "BACKEND: tpu_hash\n",
+    "BACKEND: tpu_hash\nFOLDED: 1\n",
+    pytest.param("BACKEND: tpu_hash_sharded\n",
+                 marks=pytest.mark.slow),
+], ids=["natural", "folded", "sharded"])
+def test_hist_is_trajectory_inert_under_drops(extra):
+    backend = ("tpu_hash_sharded" if "sharded" in extra else "tpu_hash")
+    r_off = _run(backend, _conf(True, extra))
+    r_on = _run(backend, _conf(True, extra + "TELEMETRY: hist\n"))
+    _assert_same_run(r_off, r_on)
+    tl = r_on.extra["timeline"]
+    assert tl["h_staleness"].shape == (50, 8)
+    assert tl["h_latency"].shape == (50, 64)
+    # Scalars still present and identical to the scalars-tier run.
+    tl_s = _run(backend,
+                _conf(True, extra + "TELEMETRY: scalars\n"))
+    tl_s = tl_s.extra["timeline"]
+    for f in ("live", "joins", "removals", "detections", "dropped"):
+        np.testing.assert_array_equal(tl[f], tl_s[f])
+    # Cross-reductions agree: occupancy mass counts live nodes; the
+    # latency histogram's per-tick mass is the detections series.
+    np.testing.assert_array_equal(tl["h_occupancy"].sum(axis=1),
+                                  tl["live"])
+    np.testing.assert_array_equal(tl["h_latency"].sum(axis=1),
+                                  tl["detections"])
+
+
+def test_hist_twins_emit_identical_histograms():
+    """Folding must not change a single bucket count: the natural and
+    FOLDED tpu_hash twins share a trajectory (fold is a reshape) and
+    the histogram builders are integer reductions over the element
+    multiset, so every [K, B] series is bit-equal.  (The sharded
+    backend's own natural/folded pair is pinned the same way at N=2048
+    in tests/test_latency_dist.py — its RNG layout gives it a DIFFERENT
+    trajectory than tpu_hash at the same conf, so cross-backend series
+    are not comparable.)"""
+    nat = _run("tpu_hash",
+               _conf(True, "BACKEND: tpu_hash\nTELEMETRY: hist\n"))
+    fold = _run("tpu_hash",
+                _conf(True, "BACKEND: tpu_hash\nFOLDED: 1\n"
+                      "TELEMETRY: hist\n"))
+    for f in ("h_staleness", "h_suspicion", "h_latency",
+              "h_occupancy", "h_drops"):
+        np.testing.assert_array_equal(nat.extra["timeline"][f],
+                                      fold.extra["timeline"][f],
+                                      err_msg=f)
 
 
 # ---------------------------------------------------------------------------
@@ -223,8 +280,109 @@ def test_kill_resume_with_telemetry_bit_exact(kill, tmp_path,
     assert any(e.get("resumed") for e in starts)
 
 
+HIST_KILL_CONF = KILL_CONF.replace("TELEMETRY: scalars\n",
+                                   "TELEMETRY: hist\n")
+
+_HIST_KILL_REF = {}
+
+
+def _hist_kill_ref(tmp_path_factory):
+    if "ref" not in _HIST_KILL_REF:
+        d = tmp_path_factory.mktemp("hist_ref")
+        p = Params.from_text(HIST_KILL_CONF + f"TELEMETRY_DIR: {d}\n")
+        r = get_backend("tpu_hash")(p, seed=7)
+        _HIST_KILL_REF["ref"] = (
+            r.extra["detection_summary"],
+            read_timeline(str(d / "timeline.jsonl")))
+    return _HIST_KILL_REF["ref"]
+
+
+@pytest.mark.parametrize("kill", [
+    # Tier-1 keeps the mid-run kill; the boundary kills pin the same
+    # convergence in the full suite (the scalars-tier test already
+    # covers all three kill points in tier-1).
+    pytest.param(50, marks=pytest.mark.slow),
+    150,
+    pytest.param(400, marks=pytest.mark.slow)])
+def test_kill_resume_with_hist_bit_exact(kill, tmp_path,
+                                         tmp_path_factory, monkeypatch):
+    """The hist tier composes with kill/resume exactly like the scalars
+    tier: after a crash at segment boundary ``kill`` and a resumed run,
+    the on-disk timeline's [K, B] histogram series — and therefore the
+    SLO verdict computed from them — are bit-equal to the uninterrupted
+    run's."""
+    from distributed_membership_tpu.observability.latency_dist import (
+        slo_verdict)
+
+    ref_summary, ref_series = _hist_kill_ref(tmp_path_factory)
+
+    d = tmp_path / "rec"
+    ckdir = tmp_path / "ckpt"
+    text = (HIST_KILL_CONF + f"TELEMETRY_DIR: {d}\n"
+            f"CHECKPOINT_DIR: {ckdir}\nRESUME: 1\n")
+    monkeypatch.setenv(ck.CRASH_ENV, str(kill))
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_backend("tpu_hash")(Params.from_text(text), seed=7)
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r = get_backend("tpu_hash")(Params.from_text(text), seed=7)
+
+    assert r.extra["detection_summary"] == ref_summary
+    series = read_timeline(str(d / "timeline.jsonl"))
+    for f in ("live", "detections", "dropped", "h_staleness",
+              "h_suspicion", "h_latency", "h_occupancy", "h_drops"):
+        np.testing.assert_array_equal(series[f], ref_series[f],
+                                      err_msg=f)
+    assert slo_verdict(series) == slo_verdict(ref_series)
+
+
 # ---------------------------------------------------------------------------
 # Recorder/reader unit contracts.
+
+@pytest.mark.quick
+def test_compare_dirs_reports_first_divergence(tmp_path):
+    """run_report --compare: identical dirs roll up identical (rc 0);
+    a diverging series names its first diverging tick (rc 2); hist
+    [K, B] series compare whole bucket rows."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import run_report
+
+    from distributed_membership_tpu.observability.timeline import (
+        HIST_BUCKETS, TELEMETRY_FIELDS, TickHist, TickTelemetry)
+
+    def write(dirname, bump_tick=None):
+        rec = TimelineRecorder(str(tmp_path / dirname))
+        k = 12
+        telem = TickTelemetry(*(np.arange(k, dtype=np.int64)
+                                for _ in TELEMETRY_FIELDS))
+        hist = {f: np.zeros((k, b), np.int64)
+                for f, b in HIST_BUCKETS.items()}
+        if bump_tick is not None:
+            hist["h_latency"][bump_tick, 3] = 1
+        rec.flush((telem, TickHist(**hist)), t0=0)
+        return str(tmp_path / dirname)
+
+    a = write("a")
+    same = write("same")
+    b = write("b", bump_tick=7)
+
+    cmp_same = run_report.compare_dirs(a, same)
+    assert cmp_same["identical"] is True
+    assert all(e["first_divergence"] is None
+               for e in cmp_same["series"].values())
+
+    cmp_diff = run_report.compare_dirs(a, b)
+    assert cmp_diff["identical"] is False
+    assert cmp_diff["series"]["h_latency"]["first_divergence"] == 7
+    assert cmp_diff["series"]["h_latency"]["diverging_ticks"] == 1
+    assert cmp_diff["series"]["live"]["first_divergence"] is None
+
+    assert run_report.main(["--compare", a, same]) == 0
+    assert run_report.main(["--compare", a, b]) == 2
+    md = run_report.render_compare_markdown(cmp_diff)
+    assert "h_latency" in md and "7" in md
+
 
 def test_recorder_dedupes_and_skips_torn_lines(tmp_path):
     from distributed_membership_tpu.observability.timeline import (

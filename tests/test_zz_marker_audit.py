@@ -1,0 +1,38 @@
+"""Tier-1 marker audit — keep the `-m 'not slow'` tier inside its CI
+budget as the suite grows.
+
+The ``zz`` prefix collects this file LAST (the suite runs in file
+order), so by the time it executes, conftest.py's logreport hook has
+recorded the call-phase duration of every test that ran this session.
+Any test that exceeded the per-test budget WITHOUT carrying the `slow`
+marker fails the audit: either mark it slow (dropping it from tier-1)
+or shrink it.  Slow-marked tests may take as long as they like — they
+only run in the full suite.
+
+The budget is per-TEST wall time, not the tier total: a single test
+hogging a minute is exactly the kind of creep that eventually blows the
+tier timeout, and per-test attribution names the offender directly.
+Override with ``DM_SLOW_BUDGET_SECONDS`` when profiling on a slow
+machine.  Partial runs (single file, -k selections) audit whatever ran;
+an empty recording passes trivially.
+"""
+
+import os
+
+import pytest
+
+import conftest
+
+
+@pytest.mark.quick
+def test_zz_nonslow_tests_within_budget():
+    budget = float(os.environ.get(conftest.SLOW_BUDGET_ENV,
+                                  conftest.SLOW_BUDGET_DEFAULT))
+    offenders = {
+        nodeid: round(dur, 1)
+        for nodeid, dur in conftest.TEST_DURATIONS.items()
+        if dur > budget and nodeid not in conftest.SLOW_MARKED
+    }
+    assert not offenders, (
+        f"tests over the {budget:.0f}s tier-1 budget without a `slow` "
+        f"marker (mark them slow or shrink them): {offenders}")
