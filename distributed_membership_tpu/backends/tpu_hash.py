@@ -1520,9 +1520,12 @@ def make_config(params: Params, collect_events: bool = True,
             # SHIFT_SET is the NATURAL-layout roll experiment: auto must
             # keep the conflicting fast paths off rather than resolve
             # into the loud gates below ("auto never raises" — only
-            # explicitly pinned knobs conflict loudly).
+            # explicitly pinned knobs conflict loudly).  The service
+            # daemon's snapshot decoder reads the NATURAL carry, so a
+            # served run keeps auto-fold off too (config.validate
+            # rejects the explicit pin loudly).
             fold_knob = int(
-                not params.SHIFT_SET
+                not params.SHIFT_SET and params.SERVICE_PORT < 0
                 and eligible and exchange == "ring"
                 and params.JOIN_MODE == "warm" and fast_agg
                 and folded_supported(n, s, params.PROBES)

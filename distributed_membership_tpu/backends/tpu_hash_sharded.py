@@ -1615,6 +1615,35 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     return final_state, events
 
 
+def resolve_mesh(params: Params, mesh: Optional[Mesh] = None) -> Mesh:
+    """The run mesh: MESH_SHAPE when pinned, else the largest device
+    count dividing N.  Single-sourced so the service daemon's served
+    sharded run shards exactly as this batch entrypoint would."""
+    if mesh is not None:
+        return mesh
+    if params.MESH_SHAPE:
+        from distributed_membership_tpu.parallel.mesh import (
+            make_torus_mesh)
+        dims = [int(x) for x in params.MESH_SHAPE.lower().split("x")]
+        return make_torus_mesh(*dims)
+    n_dev = len(jax.devices())
+    d = max(x for x in range(1, n_dev + 1)
+            if params.EN_GPSZ % x == 0)
+    return make_mesh(d)
+
+
+def bind_run_scan(mesh: Mesh):
+    """A ``run_scan``-shaped callable closed over ``mesh`` — the form
+    ``finish_run`` and the service daemon drive."""
+    def run_scan_bound(params, plan, seed, collect_events=True,
+                       total_time=None, telemetry=None):
+        return run_scan_sharded(params, plan, seed, mesh,
+                                collect_events=collect_events,
+                                total_time=total_time,
+                                telemetry=telemetry)
+    return run_scan_bound
+
+
 @register("tpu_hash_sharded")
 def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
                          seed: Optional[int] = None,
@@ -1624,25 +1653,7 @@ def run_tpu_hash_sharded(params: Params, log: Optional[EventLog] = None,
     log = log if log is not None else EventLog()
     plan = resolve_plan(params, _pyrandom.Random(f"app:{seed}"))
 
-    if mesh is None:
-        if params.MESH_SHAPE:
-            from distributed_membership_tpu.parallel.mesh import (
-                make_torus_mesh)
-            dims = [int(x) for x in params.MESH_SHAPE.lower().split("x")]
-            mesh = make_torus_mesh(*dims)
-        else:
-            n_dev = len(jax.devices())
-            d = max(x for x in range(1, n_dev + 1)
-                    if params.EN_GPSZ % x == 0)
-            mesh = make_mesh(d)
-
-    def run_scan_bound(params, plan, seed, collect_events=True,
-                       total_time=None, telemetry=None):
-        return run_scan_sharded(params, plan, seed, mesh,
-                                collect_events=collect_events,
-                                total_time=total_time,
-                                telemetry=telemetry)
-
-    result = finish_run(params, plan, log, run_scan_bound, t0, seed)
+    mesh = resolve_mesh(params, mesh)
+    result = finish_run(params, plan, log, bind_run_scan(mesh), t0, seed)
     result.extra["mesh_size"] = mesh.size
     return result

@@ -279,6 +279,24 @@ class Params:
     # exists the run starts fresh, so retry loops can always pass
     # RESUME: 1.  Requires CHECKPOINT_EVERY > 0 and CHECKPOINT_DIR.
     RESUME: int = 0
+    # Membership control plane (service/ package): -1 = off (the
+    # default batch posture), 0 = serve on an OS-assigned ephemeral
+    # port (written to <out_dir>/service.json), 1..65535 = serve on
+    # that port.  When armed the run is driven by the service daemon:
+    # between CHECKPOINT_EVERY-tick segments it publishes a host
+    # snapshot (liveness masks, heartbeat staleness, census) and
+    # drains injected scenario events into the next segment's plan
+    # tensors — so the key requires the chunked driver
+    # (CHECKPOINT_EVERY > 0) and the ring-family backends whose carry
+    # the snapshot decoder understands (tpu_hash, tpu_hash_sharded).
+    # Trajectory-inert: dbg.log/timeline.jsonl/grades are bit-exact
+    # vs. the same run with the service off (tests/test_service.py).
+    SERVICE_PORT: int = -1
+    # Decode + publish the host snapshot every k-th segment boundary
+    # (1 = every boundary).  The decode is O(N*VIEW_SIZE) numpy on the
+    # already-pulled carry; raise this on very large runs if the
+    # boundary-time decode shows up in runlog.jsonl flush_s.
+    SERVICE_SNAPSHOT_EVERY: int = 1
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -437,6 +455,38 @@ class Params:
             raise ValueError(
                 "RESUME: 1 requires CHECKPOINT_EVERY > 0 and a "
                 "CHECKPOINT_DIR to resume from")
+        if not -1 <= self.SERVICE_PORT <= 65535:
+            raise ValueError(
+                f"SERVICE_PORT must be -1 (off), 0 (ephemeral) or a "
+                f"port in 1..65535, got {self.SERVICE_PORT}")
+        if self.SERVICE_PORT >= 0:
+            # The daemon's tick engine IS the chunked driver: snapshots
+            # are decoded and events injected at segment boundaries, so
+            # a monolithic scan has no seam to serve from.
+            if self.CHECKPOINT_EVERY <= 0:
+                raise ValueError(
+                    "SERVICE_PORT requires CHECKPOINT_EVERY > 0 (the "
+                    "control plane serves between scan segments — "
+                    "runtime/checkpoint.py)")
+            # Loud-rejection policy (as TELEMETRY / PROBE_IO): the
+            # snapshot decoder reads the hash twins' packed-view carry;
+            # silently serving another backend would answer queries
+            # from a carry it cannot decode.
+            if self.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
+                raise ValueError(
+                    "SERVICE_PORT is implemented by the ring-family "
+                    "backends only (tpu_hash, tpu_hash_sharded; got "
+                    f"BACKEND {self.BACKEND!r})")
+            if self.FOLDED == 1:
+                raise ValueError(
+                    "SERVICE_PORT and FOLDED are incompatible (the "
+                    "folded plane carry is not decodable by the "
+                    "service snapshot reader; leave FOLDED on auto, "
+                    "which keeps it off under the service)")
+        if self.SERVICE_SNAPSHOT_EVERY < 1:
+            raise ValueError(
+                f"SERVICE_SNAPSHOT_EVERY must be >= 1 segment "
+                f"boundaries, got {self.SERVICE_SNAPSHOT_EVERY}")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(

@@ -21,18 +21,15 @@ from distributed_membership_tpu.grader import SCENARIO_GRADERS
 from distributed_membership_tpu.observability.metrics import write_msgcount
 
 
-def run_conf(conf_path: str, backend: str | None = None,
-             seed: int | None = None, out_dir: str = ".",
-             checkpoint_every: int | None = None,
-             checkpoint_dir: str | None = None,
-             resume: bool | None = None,
-             telemetry: str | None = None,
-             telemetry_dir: str | None = None,
-             scenario: str | None = None) -> RunResult:
-    # Validation runs AFTER the CLI overrides merge: cross-field rules
-    # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
-    # effective config, not the conf file alone.
-    params = Params.from_file(conf_path, validate=False)
+def apply_overrides(params: Params, backend: str | None = None,
+                    checkpoint_every: int | None = None,
+                    checkpoint_dir: str | None = None,
+                    resume: bool | None = None,
+                    telemetry: str | None = None,
+                    telemetry_dir: str | None = None,
+                    scenario: str | None = None) -> Params:
+    """Merge CLI overrides into an un-validated Params (shared by
+    ``run_conf`` and the service daemon's ``serve_conf``)."""
     if backend is not None:
         params.BACKEND = backend
     # Crash-recovery knobs (runtime/checkpoint.py): CLI overrides win over
@@ -54,8 +51,39 @@ def run_conf(conf_path: str, backend: str | None = None,
     # conf's SCENARIO key, same precedence as every knob above.
     if scenario is not None:
         params.SCENARIO = scenario
+    return params
+
+
+def run_conf(conf_path: str, backend: str | None = None,
+             seed: int | None = None, out_dir: str = ".",
+             checkpoint_every: int | None = None,
+             checkpoint_dir: str | None = None,
+             resume: bool | None = None,
+             telemetry: str | None = None,
+             telemetry_dir: str | None = None,
+             scenario: str | None = None) -> RunResult:
+    # Validation runs AFTER the CLI overrides merge: cross-field rules
+    # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
+    # effective config, not the conf file alone.
+    params = Params.from_file(conf_path, validate=False)
+    apply_overrides(params, backend=backend,
+                    checkpoint_every=checkpoint_every,
+                    checkpoint_dir=checkpoint_dir, resume=resume,
+                    telemetry=telemetry, telemetry_dir=telemetry_dir,
+                    scenario=scenario)
     params.validate()
-    result = get_backend(params.BACKEND)(params, EventLog(out_dir), seed=seed)
+    log = EventLog(out_dir)
+    result = None
+    if params.RESUME and params.CHECKPOINT_DIR:
+        # A served run may have journaled live injections beside its
+        # checkpoints; a headless resume must replay them or the
+        # resumed trajectory silently diverges from the acknowledged
+        # one (service/daemon.py, returns None when nothing applies).
+        from distributed_membership_tpu.service.daemon import (
+            resume_journal_run)
+        result = resume_journal_run(params, log, seed)
+    if result is None:
+        result = get_backend(params.BACKEND)(params, log, seed=seed)
     result.log.flush(out_dir)
     if not result.extra.get("aggregate"):
         # Aggregate (scale) runs carry per-node totals only; the [N, T]
@@ -203,6 +231,17 @@ def main(argv=None) -> int:
                          "JSON (crash/restart/leave/partition/link_flake/"
                          "drop_window events — scenario/ package; examples "
                          "in scenarios/ at the repo root)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run as the membership control-plane daemon "
+                         "(service/ package): serve liveness queries and "
+                         "live fault injection over HTTP between scan "
+                         "segments; requires --checkpoint-every (or the "
+                         "conf's CHECKPOINT_EVERY) and a ring-family "
+                         "backend")
+    ap.add_argument("--port", type=int, default=None, metavar="P",
+                    help="SERVICE_PORT conf key: port for --serve "
+                         "(0 = ephemeral, written to "
+                         "<out-dir>/service.json; default ephemeral)")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                     help="pin the jax platform (e.g. cpu for hermetic runs on "
                          "a virtual device mesh)")
@@ -216,6 +255,8 @@ def main(argv=None) -> int:
         return grade_all(args)
     if args.conf is None:
         ap.error("conf is required unless --grade-all is given")
+    if args.port is not None and not args.serve:
+        ap.error("--port requires --serve")
 
     if params_backend_needs_jax(args):
         # An unreachable TPU relay makes the first jax backend init hang
@@ -225,14 +266,35 @@ def main(argv=None) -> int:
             resolve_platform)
         resolve_platform(pin=args.platform)
 
-    result = run_conf(args.conf, backend=args.backend, seed=args.seed,
-                      out_dir=args.out_dir,
-                      checkpoint_every=args.checkpoint_every,
-                      checkpoint_dir=args.checkpoint_dir,
-                      resume=args.resume,
-                      telemetry=args.telemetry,
-                      telemetry_dir=args.telemetry_dir,
-                      scenario=args.scenario)
+    if args.serve:
+        # Control-plane posture (service/ package): the daemon owns the
+        # run end-to-end — artifacts, snapshots, the HTTP lifecycle —
+        # and exits 0 on a graceful stop.
+        from distributed_membership_tpu.service.daemon import serve_conf
+        return serve_conf(
+            args.conf, port=args.port, out_dir=args.out_dir,
+            seed=args.seed, backend=args.backend,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+            telemetry=args.telemetry, telemetry_dir=args.telemetry_dir,
+            scenario=args.scenario)
+
+    from distributed_membership_tpu.runtime.checkpoint import RunInterrupted
+    try:
+        result = run_conf(args.conf, backend=args.backend, seed=args.seed,
+                          out_dir=args.out_dir,
+                          checkpoint_every=args.checkpoint_every,
+                          checkpoint_dir=args.checkpoint_dir,
+                          resume=args.resume,
+                          telemetry=args.telemetry,
+                          telemetry_dir=args.telemetry_dir,
+                          scenario=args.scenario)
+    except RunInterrupted as e:
+        # Graceful SIGTERM/SIGINT: the chunked driver already barriered
+        # the checkpoint writer and flushed timeline/runlog at the stop
+        # boundary — report where to resume from and exit clean.
+        print(f"interrupted: {e} — rerun with --resume to continue")
+        return 0
 
     summary = {
         "backend": result.params.BACKEND,

@@ -59,7 +59,13 @@ _IDENTITY_EXCLUDE = frozenset(
      # Telemetry is trajectory-inert by contract (tests/test_timeline.py
      # pins bit-exactness on/off), so a resume may turn the flight
      # recorder on or move its output dir without invalidating the run.
-     "TELEMETRY", "TELEMETRY_DIR"})
+     "TELEMETRY", "TELEMETRY_DIR",
+     # The control plane (service/ package) is trajectory-inert too:
+     # snapshots are decoded from the already-pulled host carry and
+     # queries never touch device state, so a resume may serve on a
+     # different port (or not serve at all) without invalidating the
+     # run (tests/test_service.py pins serve-on/off bit-exactness).
+     "SERVICE_PORT", "SERVICE_SNAPSHOT_EVERY"})
 
 
 def params_identity(params: Params) -> str:
@@ -309,6 +315,56 @@ def _crash_tick() -> Optional[int]:
     return int(v) if v else None
 
 
+class RunInterrupted(RuntimeError):
+    """A graceful stop (SIGTERM/SIGINT, or a boundary hook's ``stop``)
+    halted :func:`chunked_run` at a segment boundary.  By the time this
+    raises, the boundary is fully durable: the background writer has
+    been barriered (the manifest points at the stop tick), and the
+    segment's telemetry/runlog records are flushed.  ``tick`` is the
+    boundary the run stopped at — ``RESUME: 1`` continues from exactly
+    there, bit-exactly."""
+
+    def __init__(self, message: str, tick: int):
+        super().__init__(message)
+        self.tick = int(tick)
+
+
+# One process-wide boundary hook (the service daemon runs one engine per
+# process).  ``hook(carry, tick)`` is called with the HOST carry once
+# before the first segment (with the start tick — the initial snapshot,
+# including a resume's restored state) and again at every segment
+# boundary after the checkpoint hand-off.  It may return None, or a dict
+# steering the remaining segments:
+#
+#   ``segment_fn``    — replacement jitted segment runner (the daemon's
+#                       event injection recompiles the step with the
+#                       merged scenario program baked in)
+#   ``extra_inputs``  — replacement scan-invariant input tuple (the
+#                       merged ScenarioTensors ride here)
+#   ``stop``          — truthy: stop before dispatching the next
+#                       segment (raises :class:`RunInterrupted` after
+#                       the writer barrier)
+_BOUNDARY_HOOK: Optional[Callable] = None
+
+
+class boundary_hook:
+    """Context manager installing the process-wide boundary hook."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def __enter__(self):
+        global _BOUNDARY_HOOK
+        self._prev = _BOUNDARY_HOOK
+        _BOUNDARY_HOOK = self.fn
+        return self
+
+    def __exit__(self, *exc):
+        global _BOUNDARY_HOOK
+        _BOUNDARY_HOOK = self._prev
+        return False
+
+
 def chunked_run(params: Params, plan, seed: int, total: int, *,
                 init_carry, segment_fn, collect_events: bool,
                 compact_fn=None, event_type=None, finalize=None,
@@ -427,7 +483,63 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                      total=int(total), every=int(every),
                      tick_start=int(start), resumed=bool(start > 0),
                      checkpoint_dir=ckpt_dir or "")
+
+    def _apply_hook(tick):
+        """Run the boundary hook on the host carry; rebind the segment
+        runner/inputs it returns.  → True when it requests a stop."""
+        nonlocal segment_fn, extra_inputs
+        if _BOUNDARY_HOOK is None:
+            return False
+        upd = _BOUNDARY_HOOK(carry, int(tick))
+        if not upd:
+            return False
+        if "segment_fn" in upd:
+            segment_fn = upd["segment_fn"]
+        if "extra_inputs" in upd:
+            extra_inputs = tuple(upd["extra_inputs"])
+        return bool(upd.get("stop"))
+
+    # Graceful interrupt: SIGTERM/SIGINT no longer kill the process
+    # wherever it happens to be (abandoning the in-flight double-
+    # buffered snapshot write) — the handler only sets a flag, checked
+    # at the next segment boundary, where the stop path barriers the
+    # background writer and flushes runlog before raising
+    # :class:`RunInterrupted`.  Signals can only be installed from the
+    # main thread; elsewhere (the bench's timing threads, pytest
+    # workers) the run keeps the process defaults.
+    import signal as _signal
+    import threading as _threading
+    stop_signal: list = []
+    orig_handlers = {}
+    if _threading.current_thread() is _threading.main_thread():
+        def _graceful(signum, frame):
+            stop_signal.append(signum)
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                orig_handlers[s] = _signal.signal(s, _graceful)
+            except (ValueError, OSError):   # pragma: no cover
+                pass
+
+    def _stop_at_boundary(tick, hook_stop):
+        if not (stop_signal or hook_stop) or tick >= total:
+            return
+        _await_writer()     # boundary `tick` is durable before we raise
+        if runlog is not None:
+            runlog.event(
+                "interrupted", tick=int(tick),
+                signal=int(stop_signal[0]) if stop_signal else 0,
+                durable_tick=int(manifest_tick(ckpt_dir) or 0))
+        raise RunInterrupted(
+            f"run stopped at segment boundary {tick} "
+            f"({'signal ' + str(stop_signal[0]) if stop_signal else 'stop requested'}); "
+            f"last durable checkpoint: {manifest_tick(ckpt_dir) or 'none'}",
+            tick)
+
     try:
+        # Initial hook call: the pre-run snapshot (a resume's restored
+        # carry included), and the seam where a resumed daemon re-arms
+        # a merged segment runner before any tick executes.
+        _stop_at_boundary(start, _apply_hook(start))
         for a in range(start, total, every):
             if crash_at is not None and a >= crash_at:
                 # Flush the in-flight snapshot first so the fault
@@ -485,8 +597,18 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                     flush_s=round(
                         time.perf_counter() - t_sync - ckpt_wait_s, 4),
                     ckpt_wait_s=round(ckpt_wait_s, 4))
+            # Boundary hook AFTER the checkpoint hand-off: the hook sees
+            # exactly the state the manifest will point at, and a
+            # runner/inputs swap it returns takes effect from the NEXT
+            # segment (the injection contract — service/daemon.py).
+            _stop_at_boundary(b, _apply_hook(b))
         _await_writer()
     finally:
+        for s, h in orig_handlers.items():
+            try:
+                _signal.signal(s, h)
+            except (ValueError, OSError):   # pragma: no cover
+                pass
         if executor is not None:
             executor.shutdown(wait=True)
     if runlog is not None:

@@ -331,11 +331,20 @@ def scenario_digest(path: str) -> str:
         return hashlib.sha256(fh.read()).hexdigest()
 
 
-def compile_scenario(scn: Scenario, params, rng):
+def compile_scenario(scn: Scenario, params, rng, force_general: bool = False):
     """→ a FailurePlan, with ``plan.scenario`` set to the
     :class:`ScenarioProgram` on the general path and ``None`` on the
     legacy lowering (where ``params`` may be mutated to carry the
     scenario's drop window through the unchanged legacy code).
+
+    ``force_general=True`` compiles even a legacy-shaped scenario on the
+    general tensor-plan path (and never mutates ``params``) — the
+    service daemon's live event injection merges the base schedule with
+    injected events and needs one uniform program shape regardless of
+    how the base run was lowered.  The two lowerings are bit-exact for
+    legacy-shaped schedules (pinned by tests/test_scenario.py), so
+    forcing the general path changes the compiled artifact, not the
+    trajectory.
     """
     from distributed_membership_tpu.runtime.failures import FailurePlan
 
@@ -385,7 +394,7 @@ def compile_scenario(scn: Scenario, params, rng):
         and all(e["kind"] == "crash" for e in point)
         and len(crash_times) <= 1 and len(windows) <= 1
         and conf_window_ok)
-    if legacy_shape:
+    if legacy_shape and not force_general:
         if windows and not params.DROP_MSG:
             w = windows[0]
             params.DROP_MSG = 1

@@ -1,0 +1,189 @@
+"""Stdlib threaded HTTP API for the membership control plane.
+
+No new dependencies: ``http.server.ThreadingHTTPServer`` with one
+daemon thread per connection.  Every query is answered from the
+published :class:`~service.snapshot.Snapshot` (or the on-disk flight
+recorder for /v1/timeline and /v1/stream) — handler threads never
+touch device state, never block the tick engine, and a torn client
+connection kills only its own thread (BrokenPipe is swallowed).
+
+Endpoints (README "Service"):
+
+  GET  /healthz               liveness + run phase + snapshot tick
+  GET  /v1/census             cluster-level counts from the snapshot
+  GET  /v1/member/<id>        one member's O(1) record
+  GET  /v1/timeline?from=T    merged per-tick series from timeline.jsonl
+  GET  /v1/stream             SSE of per-tick telemetry scalars
+  POST /v1/events             inject scenario events (202 on accept)
+  POST /v1/admin/checkpoint   wait for the next durable checkpoint
+  POST /v1/admin/shutdown     graceful: finish segment, final
+                              checkpoint + flush, exit 0
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+SSE_POLL_SECONDS = 0.25
+
+
+def _timeline_rows(path: str, start: int):
+    """Per-tick scalar dicts from tick ``start`` on (torn-tolerant)."""
+    from distributed_membership_tpu.observability.timeline import (
+        TELEMETRY_FIELDS, read_timeline)
+    series = read_timeline(path)
+    ticks = int(series.get("ticks", 0))
+    t0 = int(series.get("t0", 0))
+    rows = []
+    for i in range(max(start - t0, 0), ticks):
+        row = {"t": t0 + i}
+        row.update({f: int(series[f][i]) for f in TELEMETRY_FIELDS
+                    if f in series})
+        rows.append(row)
+    return rows
+
+
+def make_server(state, port: int) -> ThreadingHTTPServer:
+    """Build (not start) the API server bound to 127.0.0.1:``port``
+    (0 = ephemeral).  ``state`` is the daemon's ControlState."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # Content-Length is set on every JSON reply, so keep-alive is
+        # safe — and it is what lets the bench's 8 query clients reuse
+        # connections instead of paying a TCP handshake per query.
+        protocol_version = "HTTP/1.1"
+        # Every reply is two small writes on an unbuffered wfile (the
+        # header buffer flush, then the body); with Nagle on, the body
+        # write sits behind the peer's delayed ACK — a ~40 ms stall per
+        # request that caps one keep-alive client near 25 queries/s.
+        disable_nagle_algorithm = True
+
+        def log_message(self, fmt, *args):   # stdlib default is stderr
+            pass
+
+        def _json(self, code: int, obj: dict) -> None:
+            self._body(code, (json.dumps(obj) + "\n").encode())
+
+        def _body(self, code: int, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _snapshot(self):
+            snap = state.store.get()
+            if snap is None:
+                self._json(503, {"error": "no snapshot published yet"})
+            return snap
+
+        def do_GET(self):
+            try:
+                self._route_get()
+            except (BrokenPipeError, ConnectionResetError):
+                pass            # client went away; its thread exits
+
+        def do_POST(self):
+            try:
+                self._route_post()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def _route_get(self):
+            # partition, not urlparse: census/member are the bench's
+            # hot path and carry no query string.
+            upath, _, query = self.path.partition("?")
+            state.count_query()
+            if upath == "/healthz":
+                self._json(200, state.health())
+            elif upath == "/v1/census":
+                snap = self._snapshot()
+                if snap is not None:
+                    self._body(200, snap.census_json())
+            elif upath.startswith("/v1/member/"):
+                snap = self._snapshot()
+                if snap is None:
+                    return
+                try:
+                    i = int(upath[len("/v1/member/"):])
+                except ValueError:
+                    self._json(400, {"error": "member id must be an int"})
+                    return
+                if not 0 <= i < snap.n:
+                    self._json(404, {"error": f"member {i} out of range "
+                                              f"[0, {snap.n})"})
+                    return
+                self._json(200, snap.member(i))
+            elif upath == "/v1/timeline":
+                path = state.timeline_path()
+                if not path or not os.path.exists(path):
+                    self._json(404, {"error": "no timeline (run with "
+                                              "TELEMETRY scalars and a "
+                                              "TELEMETRY_DIR)"})
+                    return
+                q = parse_qs(query)
+                start = int(q.get("from", ["0"])[0])
+                self._json(200, {"from": start,
+                                 "rows": _timeline_rows(path, start)})
+            elif upath == "/v1/stream":
+                self._stream()
+            else:
+                self._json(404, {"error": f"unknown path {upath!r}"})
+
+        def _route_post(self):
+            if self.path == "/v1/events":
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as e:
+                    self._json(400, {"error": f"invalid JSON ({e})"})
+                    return
+                events = (body.get("events", [body])
+                          if isinstance(body, dict) else body)
+                code, reply = state.inject(events)
+                self._json(code, reply)
+            elif self.path == "/v1/admin/checkpoint":
+                code, reply = state.checkpoint_barrier()
+                self._json(code, reply)
+            elif self.path == "/v1/admin/shutdown":
+                state.request_shutdown()
+                self._json(200, {"stopping": True,
+                                 "status": state.status})
+            else:
+                self._json(404, {"error": f"unknown path {self.path!r}"})
+
+        def _stream(self):
+            """SSE: per-tick telemetry scalars as they reach the
+            on-disk timeline, one ``data:`` message per tick.  The
+            loop ends when the client disconnects (write raises) or
+            the daemon stops."""
+            path = state.timeline_path()
+            if not path:
+                self._json(404, {"error": "no telemetry stream (run "
+                                          "with TELEMETRY scalars and "
+                                          "a TELEMETRY_DIR)"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            sent_to = 0
+            while not state.stopped():
+                if os.path.exists(path):
+                    for row in _timeline_rows(path, sent_to):
+                        msg = f"data: {json.dumps(row)}\n\n".encode()
+                        self.wfile.write(msg)
+                        sent_to = row["t"] + 1
+                    self.wfile.flush()
+                if state.run_complete() and sent_to >= state.total:
+                    break
+                time.sleep(SSE_POLL_SECONDS)
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    server.daemon_threads = True
+    return server

@@ -1,0 +1,355 @@
+"""The control-plane daemon: serve driver + boundary hook.
+
+Layout of a served run (``--serve``):
+
+  * the TICK ENGINE runs in the MAIN thread — it is the unchanged
+    backend entrypoint tail (``resolve_plan`` → ``finish_run`` →
+    ``chunked_run``), so a served run computes byte-for-byte what the
+    batch run computes (tests/test_service.py pins dbg.log equality);
+  * the HTTP API (service/api.py) runs on a daemon thread, answering
+    from the published snapshot;
+  * the seam between them is ``runtime/checkpoint.boundary_hook``: at
+    every segment boundary the engine calls into :func:`_make_hook`'s
+    closure with the host carry, which (a) publishes a fresh
+    :class:`~service.snapshot.Snapshot`, (b) drains accepted injections
+    into a recompiled segment runner (service/events.py), and (c)
+    relays a shutdown request as a ``stop``, which the engine honors by
+    barriering the checkpoint writer and raising ``RunInterrupted`` —
+    the graceful exit (finish segment, final checkpoint + timeline
+    flush, exit 0).
+
+After the run completes the daemon writes the batch artifacts
+(dbg.log/stats.log/msgcount.log) and keeps serving the final snapshot
+until ``POST /v1/admin/shutdown`` (or SIGTERM/SIGINT) stops it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import List, Optional
+
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.observability.metrics import write_msgcount
+from distributed_membership_tpu.service.events import (
+    JOURNAL_NAME, EventJournal, apply_merge, base_events,
+    injection_unsupported, validate_injection)
+from distributed_membership_tpu.service.snapshot import (
+    SnapshotStore, decode_state)
+
+SERVICE_JSON = "service.json"
+
+
+class ControlState:
+    """Shared state between the engine (main thread) and the API
+    handlers (per-connection daemon threads).  The lock covers the
+    mutable command-queue fields; the snapshot path is lock-free
+    (reference swap)."""
+
+    def __init__(self, params: Params, plan, seed: int, total: int,
+                 journal: Optional[EventJournal], base_evs: List[dict]):
+        self.params = params
+        self.plan = plan
+        self.seed = int(seed)
+        self.total = int(total)
+        self.journal = journal
+        self.base_events = base_evs
+        self.store = SnapshotStore()
+        self.status = "starting"   # running | complete | interrupted
+        self.tick = 0
+        self.port: Optional[int] = None
+        self.queries = 0
+        self.pending: List[dict] = []   # accepted, awaiting a boundary
+        self.applied: List[dict] = []   # already merged into the plan
+        self.applied_at: List[dict] = []  # [{tick, events}] audit trail
+        self.snapshot_error = ""
+        self.stop_event = threading.Event()
+        self._lock = threading.Lock()
+        self._inject_unsupported = injection_unsupported(params)
+
+    # ---- query side -------------------------------------------------
+    def count_query(self) -> None:
+        with self._lock:
+            self.queries += 1
+
+    def health(self) -> dict:
+        snap = self.store.get()
+        h = {
+            "status": self.status,
+            "tick": self.tick,
+            "total": self.total,
+            "backend": self.params.BACKEND,
+            "n": self.params.EN_GPSZ,
+            "port": self.port,
+            "queries_served": self.queries,
+            "pending_events": len(self.pending),
+            "applied_events": len(self.applied),
+            "snapshot_tick": None if snap is None else snap.tick,
+            "snapshot_age_s": (None if snap is None else
+                               round(time.time() - snap.decoded_at, 3)),
+        }
+        if self.snapshot_error:
+            h["snapshot_error"] = self.snapshot_error
+        return h
+
+    def timeline_path(self) -> Optional[str]:
+        if self.params.TELEMETRY_DIR and self.params.TELEMETRY != "off":
+            from distributed_membership_tpu.observability.timeline import (
+                TIMELINE_NAME)
+            return os.path.join(self.params.TELEMETRY_DIR, TIMELINE_NAME)
+        return None
+
+    def stopped(self) -> bool:
+        return self.stop_event.is_set()
+
+    def run_complete(self) -> bool:
+        return self.status in ("complete", "interrupted")
+
+    # ---- command side -----------------------------------------------
+    def inject(self, events) -> tuple:
+        """POST /v1/events → (http_code, reply dict)."""
+        if not isinstance(events, list):
+            return 400, {"error": "body must be an event object or "
+                                  "{'events': [...]}"}
+        if self._inject_unsupported:
+            code = 501 if self.params.BACKEND == "tpu_hash_sharded" else 409
+            return code, {"error": self._inject_unsupported}
+        if self.run_complete():
+            return 409, {"error": f"run is {self.status}; no further "
+                                  "segments to inject into"}
+        with self._lock:
+            # The hook drains under this lock and bumps self.tick at
+            # the boundary FIRST, so this bound is the earliest
+            # boundary the event is guaranteed to be merged at.
+            next_tick = min(self.tick + self.params.CHECKPOINT_EVERY,
+                            self.total)
+            try:
+                validate_injection(events, self.params, next_tick)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            if self.journal is not None:
+                # Durability before the ACK: an acknowledged event
+                # survives any kill (RESUME replays the journal).
+                self.journal.append(events)
+            self.pending.extend(events)
+        return 202, {"accepted": len(events), "apply_at_tick": next_tick,
+                     "journaled": self.journal is not None}
+
+    def checkpoint_barrier(self, timeout_s: float = 120.0) -> tuple:
+        """POST /v1/admin/checkpoint: block until a checkpoint at or
+        after the current tick is durable, return its tick."""
+        from distributed_membership_tpu.runtime.checkpoint import (
+            manifest_tick)
+        ckpt_dir = self.params.CHECKPOINT_DIR or None
+        if not ckpt_dir:
+            return 409, {"error": "no CHECKPOINT_DIR configured"}
+        want = self.tick
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            t = manifest_tick(ckpt_dir)
+            if t is not None and (t >= want or self.run_complete()):
+                return 200, {"tick": int(t)}
+            if self.stopped():
+                break
+            time.sleep(0.1)
+        return 504, {"error": "timed out waiting for a durable "
+                              "checkpoint", "durable_tick":
+                     manifest_tick(ckpt_dir)}
+
+    def request_shutdown(self) -> None:
+        self.stop_event.set()
+
+
+def _make_hook(state: ControlState):
+    """The boundary-hook closure driving snapshots/injection/stop."""
+    params = state.params
+    n, tfail = params.EN_GPSZ, params.TFAIL
+    decode_every = max(params.SERVICE_SNAPSHOT_EVERY, 1)
+    boundary_no = [0]
+
+    def hook(carry, tick: int):
+        i, boundary_no[0] = boundary_no[0], boundary_no[0] + 1
+        if i % decode_every == 0 or tick >= state.total:
+            try:
+                state.store.publish(decode_state(carry, tick, n, tfail))
+            except AttributeError as e:   # undecodable carry layout
+                state.snapshot_error = str(e)
+        upd = {}
+        with state._lock:
+            state.tick = tick
+            drained, state.pending = state.pending, []
+        if drained:
+            state.applied.extend(drained)
+            state.applied_at.append({"tick": int(tick),
+                                     "events": len(drained)})
+            # Recompile the merged program and swap the segment runner
+            # + scenario tensors from the NEXT segment on.  The plan is
+            # mutated in place so finish_run's tail (dbg lines, oracle)
+            # matches an uninterrupted union-scenario run.
+            from distributed_membership_tpu.backends.tpu_hash import (
+                _get_segment_runner, make_config, plan_fail_ids)
+            apply_merge(params, state.plan, state.base_events,
+                        state.applied, state.seed)
+            cfg = make_config(params, collect_events=True,
+                              fail_ids=plan_fail_ids(state.plan),
+                              scenario=state.plan.scenario.static)
+            upd["segment_fn"] = _get_segment_runner(
+                cfg, params.JOIN_MODE == "warm")
+            upd["extra_inputs"] = (state.plan.scenario.tensors(),)
+        if state.stop_event.is_set():
+            upd["stop"] = True
+        return upd or None
+
+    return hook
+
+
+def _run_backend(params: Params, plan, log: EventLog, seed: int,
+                 t0: float):
+    """The backend entrypoint tail, with the resolved plan held by the
+    CALLER (so the boundary hook can mutate it) — otherwise identical
+    to run_tpu_hash / run_tpu_hash_sharded."""
+    from distributed_membership_tpu.backends.tpu_sparse import finish_run
+    if params.BACKEND == "tpu_hash_sharded":
+        from distributed_membership_tpu.backends.tpu_hash_sharded import (
+            bind_run_scan, resolve_mesh)
+        mesh = resolve_mesh(params)
+        result = finish_run(params, plan, log, bind_run_scan(mesh), t0,
+                            seed)
+        result.extra["mesh_size"] = mesh.size
+        return result
+    from distributed_membership_tpu.backends.tpu_hash import run_scan
+    return finish_run(params, plan, log, run_scan, t0, seed)
+
+
+def _write_service_json(out_dir: str, state: ControlState) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, SERVICE_JSON), "w") as fh:
+        json.dump({"port": state.port, "pid": os.getpid(),
+                   "backend": state.params.BACKEND,
+                   "n": state.params.EN_GPSZ, "total": state.total},
+                  fh, indent=1)
+
+
+def resume_journal_run(params: Params, log: EventLog,
+                       seed: Optional[int] = None):
+    """Headless ``--resume`` of a SERVED checkpoint: replay the
+    acknowledged injections journaled beside the checkpoints, so a
+    restart WITHOUT ``--serve`` still reproduces the served
+    trajectory bit-exactly (dbg.log included — the merged plan also
+    owns the 'Node failed' banner lines).
+
+    Returns the RunResult, or None when there is nothing to replay
+    (no journal / empty journal) and the plain backend path should
+    run.  Called by ``run_conf`` whenever RESUME + CHECKPOINT_DIR are
+    set; a non-empty journal on a backend the merge path cannot drive
+    raises rather than silently dropping acknowledged events."""
+    from distributed_membership_tpu.runtime.failures import resolve_plan
+    path = os.path.join(params.CHECKPOINT_DIR, JOURNAL_NAME)
+    if not os.path.exists(path):
+        return None
+    replay = EventJournal(path).read()
+    if not replay:
+        return None
+    if params.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
+        raise ValueError(
+            f"checkpoint dir {params.CHECKPOINT_DIR!r} holds a service "
+            f"event journal ({len(replay)} injected events) but backend "
+            f"{params.BACKEND!r} cannot replay it — resume with the "
+            "backend that served the run")
+    t0 = time.time()
+    seed = params.SEED if seed is None else seed
+    plan = resolve_plan(params, random.Random(f"app:{seed}"))
+    apply_merge(params, plan, base_events(params, plan), replay, seed)
+    return _run_backend(params, plan, log, seed, t0)
+
+
+def serve_run(params: Params, seed: Optional[int] = None,
+              out_dir: str = ".") -> int:
+    """Drive one served run to completion (or graceful stop); → exit
+    code.  ``params`` must already be validated with
+    ``SERVICE_PORT >= 0``.  Runs the engine in the calling thread —
+    call from the main thread so SIGTERM/SIGINT get the graceful
+    boundary-stop treatment (runtime/checkpoint.py)."""
+    from distributed_membership_tpu.runtime.checkpoint import (
+        RunInterrupted, boundary_hook)
+    from distributed_membership_tpu.runtime.failures import resolve_plan
+    from distributed_membership_tpu.service import api
+
+    t0 = time.time()
+    seed = params.SEED if seed is None else seed
+    log = EventLog(out_dir)
+    plan = resolve_plan(params, random.Random(f"app:{seed}"))
+    base_evs = base_events(params, plan)
+    ckpt_dir = params.CHECKPOINT_DIR or None
+    journal = (EventJournal(os.path.join(ckpt_dir, JOURNAL_NAME))
+               if ckpt_dir else None)
+
+    state = ControlState(params, plan, seed, params.TOTAL_TIME, journal,
+                         base_evs)
+    if journal is not None:
+        if params.RESUME:
+            # Replay acknowledged injections BEFORE the first segment:
+            # the resumed run compiles the merged program from the
+            # start (events are inert before their times, so the
+            # pre-injection prefix is unchanged — bit-exactness pinned
+            # in tests/test_service.py).
+            replay = journal.read()
+            if replay:
+                state.applied = list(replay)
+                apply_merge(params, plan, base_evs, state.applied, seed)
+        else:
+            journal.reset()
+
+    server = api.make_server(state, params.SERVICE_PORT)
+    state.port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="service-api").start()
+    _write_service_json(out_dir, state)
+    print(f"service: listening on 127.0.0.1:{state.port} "
+          f"(pid {os.getpid()})", flush=True)
+
+    try:
+        try:
+            with boundary_hook(_make_hook(state)):
+                state.status = "running"
+                result = _run_backend(params, plan, log, seed, t0)
+        except RunInterrupted as e:
+            state.status = "interrupted"
+            print(f"service: {e} — resume with --resume", flush=True)
+            return 0
+        state.status = "complete"
+        # The batch driver's artifact tail (runtime/application.py).
+        result.log.flush(out_dir)
+        if not result.extra.get("aggregate"):
+            write_msgcount(result, out_dir)
+        print(f"service: run complete at tick {state.tick}; serving "
+              "until /v1/admin/shutdown", flush=True)
+        try:
+            state.stop_event.wait()
+        except KeyboardInterrupt:
+            pass
+        return 0
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def serve_conf(conf_path: str, port: Optional[int] = None,
+               out_dir: str = ".", **overrides) -> int:
+    """CLI entry (``--serve``): parse + override like ``run_conf``,
+    arm SERVICE_PORT, validate, then :func:`serve_run`."""
+    from distributed_membership_tpu.runtime.application import (
+        apply_overrides)
+    seed = overrides.pop("seed", None)
+    params = Params.from_file(conf_path, validate=False)
+    apply_overrides(params, **overrides)
+    if port is not None:
+        params.SERVICE_PORT = port
+    elif params.SERVICE_PORT < 0:
+        params.SERVICE_PORT = 0       # --serve alone: ephemeral port
+    params.validate()
+    return serve_run(params, seed=seed, out_dir=out_dir)

@@ -20,11 +20,19 @@ the timeline; ``--compare A B`` diffs two recorder directories series by
 series and reports the first diverging tick — the bisect primitive for
 "same run, different twin/resume/knob" investigations.
 
+``--watch`` turns the one-shot report into a live dashboard for a run
+in flight (``--serve`` or plain chunked): re-read the recorder streams
+every ``--interval`` seconds and re-render (screen-clear on a tty, a
+separator banner otherwise) until Ctrl-C.  The readers are all
+torn-line tolerant, so watching a directory the run is actively
+appending to is safe.
+
 Usage:
   python scripts/run_report.py --dir <TELEMETRY_DIR>            # markdown
   python scripts/run_report.py --dir <dir> --json               # dict
   python scripts/run_report.py --dir <dir> --out report.md
   python scripts/run_report.py --dir <dir> --slo                # + verdict
+  python scripts/run_report.py --dir <dir> --watch --interval 2
   python scripts/run_report.py --compare <dirA> <dirB>
   python scripts/run_report.py --ladder artifacts/ladder_events.jsonl
 """
@@ -35,6 +43,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -337,6 +346,31 @@ def render_compare_markdown(cmp: dict) -> str:
     return "\n".join(lines)
 
 
+def watch(args, iterations: int | None = None) -> int:
+    """Poll-and-re-render loop (``--watch``).
+
+    ``iterations`` caps the loop for tests; interactive use runs until
+    KeyboardInterrupt (exit 0 — stopping a dashboard isn't an error).
+    """
+    i = 0
+    try:
+        while iterations is None or i < iterations:
+            report = build_report(args.dir, args.ladder, slo=args.slo)
+            text = (json.dumps(report, indent=1) if args.json
+                    else render_markdown(report))
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            else:
+                print(f"--- run_report watch #{i} ---")
+            print(text, flush=True)
+            i += 1
+            if iterations is None or i < iterations:
+                time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dir", default=None,
@@ -359,7 +393,16 @@ def main(argv=None) -> int:
                     default=None,
                     help="diff two recorder directories series-by-series "
                          "and report the first diverging tick")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-render every --interval seconds until "
+                         "Ctrl-C (live view of a run in flight)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="polling period for --watch (default 2s)")
     args = ap.parse_args(argv)
+    if args.watch and args.compare:
+        ap.error("--watch and --compare are mutually exclusive")
+    if args.watch and args.out:
+        ap.error("--watch renders to stdout; drop --out")
     if args.compare:
         cmp = compare_dirs(*args.compare)
         text = (json.dumps(cmp, indent=1) if args.json
@@ -378,6 +421,9 @@ def main(argv=None) -> int:
             args.ladder = default_ladder
         else:
             ap.error("pass --dir and/or --ladder")
+
+    if args.watch:
+        return watch(args)
 
     report = build_report(args.dir, args.ladder, slo=args.slo)
     if args.slo:

@@ -126,3 +126,39 @@ def test_probe_attribution_exact_flag():
     bad.PROBE_IO = "sometimes"
     with pytest.raises(ValueError, match="PROBE_IO"):
         bad.validate()
+
+
+def test_service_keys_round_trip_and_rules():
+    base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 100\n"
+            "JOIN_MODE: warm\nBACKEND: tpu_hash\nCHECKPOINT_EVERY: 25\n")
+    p = Params.from_text(base + "SERVICE_PORT: 8080\n"
+                                "SERVICE_SNAPSHOT_EVERY: 4\n")
+    assert p.SERVICE_PORT == 8080
+    assert p.SERVICE_SNAPSHOT_EVERY == 4
+    # Off by default; 0 = ephemeral port is valid.
+    assert Params.from_text(base).SERVICE_PORT == -1
+    assert Params.from_text(base + "SERVICE_PORT: 0\n").SERVICE_PORT == 0
+
+    with pytest.raises(ValueError, match="SERVICE_PORT"):
+        Params.from_text(base + "SERVICE_PORT: 65536\n")
+    with pytest.raises(ValueError, match="SERVICE_PORT"):
+        Params.from_text(base + "SERVICE_PORT: -2\n")
+    # Serving drives the chunked driver: CHECKPOINT_EVERY required.
+    with pytest.raises(ValueError, match="CHECKPOINT_EVERY"):
+        Params.from_text(base.replace("CHECKPOINT_EVERY: 25\n", "")
+                         + "SERVICE_PORT: 0\n")
+    # Only the ring-family carries decode into snapshots.
+    with pytest.raises(ValueError, match="ring-family"):
+        Params.from_text(base.replace("BACKEND: tpu_hash", "BACKEND: tpu")
+                         + "SERVICE_PORT: 0\n")
+    # The folded carry is undecodable; the auto knob must stay auto.
+    with pytest.raises(ValueError, match="FOLDED"):
+        Params.from_text(base + "SERVICE_PORT: 0\nFOLDED: 1\n")
+    with pytest.raises(ValueError, match="SERVICE_SNAPSHOT_EVERY"):
+        Params.from_text(base + "SERVICE_PORT: 0\n"
+                                "SERVICE_SNAPSHOT_EVERY: 0\n")
+    # The sharded backend serves (queries only; injection 501s).
+    Params.from_text(base.replace("BACKEND: tpu_hash",
+                                  "BACKEND: tpu_hash_sharded")
+                     + "SERVICE_PORT: 0\n")

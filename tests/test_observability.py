@@ -293,3 +293,36 @@ def test_maybe_runlog_max_bytes_env_knob(tmp_path, monkeypatch):
     assert maybe_runlog(str(tmp_path / "junk")).max_bytes == 4 << 20
     monkeypatch.setenv("DM_RUNLOG_MAX_BYTES", "-5")
     assert maybe_runlog(str(tmp_path / "neg")).max_bytes == 4 << 20
+
+
+def test_run_report_watch_renders_live(tmp_path, capsys):
+    import argparse
+
+    import run_report
+
+    # An empty dir renders the placeholder; then artifacts appearing
+    # between polls show up in the next frame (the --watch contract:
+    # re-read everything each iteration, torn-tolerantly).
+    args = argparse.Namespace(dir=str(tmp_path), ladder=None, slo=False,
+                              json=False, interval=0.01)
+    assert run_report.watch(args, iterations=1) == 0
+    first = capsys.readouterr().out
+    assert "watch #0" in first          # non-tty: separator banner
+    assert "no recorder artifacts" in first
+
+    log = RunLog(str(tmp_path / "runlog.jsonl"))
+    log.event("segment", t0=0, t1=50, device_sync_s=0.5, ckpt_wait_s=0.0,
+              flush_s=0.1)
+    assert run_report.watch(args, iterations=2) == 0
+    out = capsys.readouterr().out
+    assert "watch #1" in out
+    assert "Segment timings" in out
+
+
+def test_run_report_watch_flag_conflicts():
+    import run_report
+
+    with pytest.raises(SystemExit):
+        run_report.main(["--watch", "--compare", "a", "b"])
+    with pytest.raises(SystemExit):
+        run_report.main(["--dir", "x", "--watch", "--out", "r.md"])
