@@ -160,6 +160,11 @@ def _service_client_main(port: int, n: int) -> int:
     counts = [0] * clients
 
     depth = int(os.environ.get("BENCH_SERVICE_PIPELINE", "8"))
+    # BENCH_SERVICE_PREFIX reroutes the same load through mount
+    # prefixes — the fleet leg passes a comma-separated list of
+    # ``/v1/runs/<id>`` mounts and each client sticks to one, so K
+    # clients spread across the fleet's runs.
+    prefixes = os.environ.get("BENCH_SERVICE_PREFIX", "").split(",")
 
     def worker(i):
         # Raw sockets, prebuilt request bytes, HTTP/1.1 pipelining
@@ -168,9 +173,11 @@ def _service_client_main(port: int, n: int) -> int:
         # scheduler wakeup per query would be billed to the tick loop.
         # BaseHTTPRequestHandler reads requests from a buffered rfile,
         # so pipelined requests are answered in order.
-        single = [(b"GET /v1/census HTTP/1.1\r\nHost: l\r\n\r\n"
+        pref = prefixes[i % len(prefixes)]
+        single = [(f"GET {pref}/v1/census HTTP/1.1\r\nHost: l\r\n\r\n"
+                   .encode()
                    if (i + j) % 2 else
-                   (f"GET /v1/member/{(j * 2654435761 + i) % n} "
+                   (f"GET {pref}/v1/member/{(j * 2654435761 + i) % n} "
                     "HTTP/1.1\r\nHost: l\r\n\r\n").encode())
                   for j in range(32)]
         batches = [b"".join(single[j % 32] for j in range(k, k + depth))
@@ -410,6 +417,224 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
             / max(walls["base"], 1e-9), 1),
         "service_queries_per_sec": round(qps, 1),
     }
+
+
+def _bench_fleet() -> dict:
+    """BENCH_FLEET=1: price the fleet control plane (fleet/).
+
+    One REAL controller subprocess multiplexing BENCH_FLEET_RUNS
+    (default 4) concurrent N=10 serve workers — the reference protocol
+    size, so the leg prices the control plane, not the engine — run
+    through the same interleaved best-of-R pairing as the other
+    comparison legs: an unloaded sweep vs the same sweep with
+    BENCH_SERVICE_CLIENTS pipelined clients (the _service_client_main
+    load generator, rerouted through the ``/v1/runs/<id>/`` proxy
+    mounts, each client pinned to one run).  Two numbers ride into the
+    perf ledger: sustained proxied q/s across the fleet, and the
+    per-run tick-loop slowdown — mean per-run post-compile segment
+    seconds (runlog.jsonl), loaded vs not — i.e. what multiplexing N
+    engines plus a query storm behind one controller costs each run.
+    """
+    import http.client as _hc
+    import shutil
+    import tempfile
+
+    from distributed_membership_tpu.observability.runlog import (
+        read_events)
+
+    runs_n = int(os.environ.get("BENCH_FLEET_RUNS", "4"))
+    n = int(os.environ.get("BENCH_FLEET_N", "10"))
+    ticks = int(os.environ.get("BENCH_FLEET_TICKS", "3000"))
+    every = int(os.environ.get("BENCH_FLEET_EVERY", "50"))
+    reps = int(os.environ.get("BENCH_FLEET_REPS", "1"))
+    clients = int(os.environ.get("BENCH_SERVICE_CLIENTS", "8"))
+    conf = (f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            f"MSG_DROP_PROB: 0\nVIEW_SIZE: 8\n"
+            f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
+            f"BACKEND: tpu_hash\nEVENT_MODE: full\n"
+            f"CHECKPOINT_EVERY: {every}\nTELEMETRY: scalars\n"
+            f"TOTAL_TIME: {ticks}\n")
+    qps_stats = []          # one {"queries", "seconds"} per loaded rep
+
+    def _rq(port, method, path, body=None):
+        conn = _hc.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request(
+                method, path,
+                body=None if body is None else json.dumps(body),
+                headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, json.loads(r.read() or b"{}")
+        finally:
+            conn.close()
+
+    def _sweep(loaded: bool) -> float:
+        """One controller + runs_n concurrent runs to completion;
+        -> mean per-run post-compile tick-loop seconds."""
+        root = tempfile.mkdtemp(prefix="bench_fleet_")
+        fconf = os.path.join(root, "fleet.conf")
+        with open(fconf, "w") as fh:
+            fh.write(f"FLEET_MAX_CONCURRENCY: {runs_n}\n")
+        log = open(os.path.join(root, "fleet.log"), "ab")
+        ctrl = subprocess.Popen(
+            [sys.executable, "-m", "distributed_membership_tpu",
+             fconf, "--fleet", "--out-dir", root],
+            stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        client, port = None, None
+        try:
+            fj = os.path.join(root, "fleet.json")
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    with open(fj) as fh:
+                        info = json.load(fh)
+                    if info.get("pid") == ctrl.pid:
+                        port = info["port"]
+                        break
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.05)
+            if port is None:
+                raise RuntimeError("fleet.json never appeared")
+            ids = [f"f{i}" for i in range(runs_n)]
+            for i, rid in enumerate(ids):
+                code, obj = _rq(port, "POST", "/v1/runs",
+                                {"conf": conf, "run_id": rid,
+                                 "seed": i + 1})
+                if code != 202:
+                    raise RuntimeError(f"fleet refused {rid}: {obj}")
+            if loaded:
+                env = dict(os.environ)
+                env["BENCH_SERVICE_PREFIX"] = ",".join(
+                    f"/v1/runs/{r}" for r in ids)
+                client = subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--service-client", str(port), "--n", str(n)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True, env=env)
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                _, obj = _rq(port, "GET", "/v1/runs")
+                states = [r["state"] for r in obj.get("runs", [])]
+                if states and all(s == "done" for s in states):
+                    break
+                if any(s in ("failed", "killed") for s in states):
+                    raise RuntimeError(f"fleet run died: {obj}")
+                time.sleep(0.1)
+            if client is not None:
+                try:
+                    out, _ = client.communicate(input="stop\n",
+                                                timeout=60)
+                except subprocess.TimeoutExpired:
+                    client.kill()
+                    out = ""
+                client = None
+                for line in reversed((out or "").strip().splitlines()):
+                    try:
+                        qps_stats.append(json.loads(line))
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            per_run = []
+            for rid in ids:
+                segs = [e for e in read_events(
+                            os.path.join(root, rid, "runlog.jsonl"))
+                        if e.get("kind") == "segment"]
+                # The first segment carries the compile; the tick-loop
+                # cost is the warm remainder.
+                warm = segs[1:] if len(segs) > 1 else segs
+                per_run.append(sum(e.get("device_sync_s", 0.0)
+                                   for e in warm))
+            return sum(per_run) / max(len(per_run), 1)
+        finally:
+            if client is not None:
+                client.kill()
+            if port is not None:
+                try:
+                    _rq(port, "POST", "/v1/admin/shutdown")
+                except Exception:
+                    pass
+            try:
+                ctrl.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                ctrl.kill()
+            shutil.rmtree(root, ignore_errors=True)
+
+    arm_means = {False: [], True: []}
+
+    def _fleet_scan(params, plan, seed=0, collect_events=False,
+                    total_time=None):
+        """run_scan-shaped shim so _interleaved_best can interleave
+        the arms (``params`` is the loaded flag); the sweep wall it
+        implicitly times is reported, but the headline metric is the
+        per-run tick-loop time recorded here from the runlogs."""
+        arm_means[bool(params)].append(_sweep(loaded=bool(params)))
+        return None, None
+
+    base_wall, _ = _timed_runs(_fleet_scan, False, None, ticks)
+    walls = _interleaved_best(_fleet_scan, ticks, (False, None),
+                              {"loaded": (True, None)}, reps,
+                              base_wall)
+    base_s = min(arm_means[False])
+    loaded_s = min(arm_means[True])
+    qps = max((r["queries"] / r["seconds"] for r in qps_stats),
+              default=0.0)
+    warm_ticks = max(ticks - every, 1)
+    return {
+        "leg": "fleet",
+        "platform": os.environ.get("DM_RESOLVED_PLATFORM") or "cpu",
+        "fleet_runs": runs_n, "fleet_clients": clients,
+        "n": n, "ticks": ticks, "view_size": 8,
+        "fleet_sweep_wall_seconds": round(walls["base"], 3),
+        "fleet_sweep_loaded_wall_seconds": round(walls["loaded"], 3),
+        "fleet_base_run_seconds": round(base_s, 3),
+        "fleet_loaded_run_seconds": round(loaded_s, 3),
+        "fleet_run_slowdown_pct": round(
+            100 * (loaded_s - base_s) / max(base_s, 1e-9), 1),
+        "fleet_run_ticks_per_sec": round(
+            warm_ticks / max(loaded_s, 1e-9), 1),
+        "fleet_queries_per_sec": round(qps, 1),
+    }
+
+
+def _ledger_bank_fleet(row: dict) -> None:
+    """Bank the fleet leg's two trends (proxied q/s, loaded per-run
+    tick rate) into artifacts/perf_ledger.jsonl; telemetry-tolerant
+    like _ledger_bank."""
+    try:
+        from distributed_membership_tpu.observability import perfdb
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, perfdb.LEDGER_PATH)
+        knobs = {"runs": row["fleet_runs"],
+                 "clients": row["fleet_clients"],
+                 "ticks": row["ticks"],
+                 "slowdown_pct": row["fleet_run_slowdown_pct"]}
+        rows = [
+            perfdb.make_row(
+                "bench:live:fleet",
+                metric="fleet_queries_per_sec",
+                value=row["fleet_queries_per_sec"], n=row["n"],
+                s=row["view_size"], backend="tpu_hash",
+                platform=row["platform"], knobs=knobs,
+                source="bench.py"),
+            perfdb.make_row(
+                "bench:live:fleet:tickloop",
+                metric="fleet_run_ticks_per_sec",
+                value=row["fleet_run_ticks_per_sec"], n=row["n"],
+                s=row["view_size"], backend="tpu_hash",
+                platform=row["platform"], knobs=knobs,
+                source="bench.py"),
+        ]
+        perfdb.append_rows(rows, path)
+        for reg in perfdb.check(perfdb.load_ledger(path)):
+            print(f"warning: perf_ledger regression: {reg['rung']} "
+                  f"{reg['metric']} {reg['value']:.1f} vs best "
+                  f"{reg['best']:.1f} (-{reg['drop_pct']}%)",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"warning: perf ledger update failed: {e}",
+              file=sys.stderr)
 
 
 def _mode_str(frecv, fgossip, folded) -> str:
@@ -886,12 +1111,15 @@ def _run_leg(leg: str, n: int, ticks: int, pin_cpu: bool,
         return None
     if isinstance(row, dict) and row.get("node_ticks_per_sec"):
         _ledger_bank(leg, row)
+    elif isinstance(row, dict) and row.get("leg") == "fleet":
+        _ledger_bank_fleet(row)
     return row
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--leg", choices=["hash", "dense"], default=None)
+    ap.add_argument("--leg", choices=["hash", "dense", "fleet"],
+                    default=None)
     ap.add_argument("--n", type=int, default=0)
     ap.add_argument("--ticks", type=int, default=0)
     ap.add_argument("--view", type=int, default=0)
@@ -907,6 +1135,8 @@ def main() -> int:
         pin = "cpu" if args.pin_cpu else None
         if args.leg == "hash":
             print(json.dumps(leg_hash(args.n, args.ticks, pin, args.view)))
+        elif args.leg == "fleet":
+            print(json.dumps(_bench_fleet()))
         else:
             print(json.dumps(leg_dense(args.n, args.ticks, pin)))
         return 0
@@ -1096,6 +1326,11 @@ def main() -> int:
         dense_res["note"] = ("below C++ reference wall-clock rate "
                              "(exact-parity O(N^2) path at "
                              f"N={dense_res['n']} vs reference N=10)")
+    if os.environ.get("BENCH_FLEET", "0") not in ("", "0"):
+        # Fleet control-plane overhead leg: one real controller
+        # multiplexing concurrent serve workers, with and without a
+        # pipelined query storm through the /v1/runs/<id>/ mounts.
+        out["fleet"] = _run_leg("fleet", 0, 0, False, timeout)
     print(json.dumps(out))
     return 0
 

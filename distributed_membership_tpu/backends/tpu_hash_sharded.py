@@ -1474,31 +1474,25 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
     return _RUNNER_CACHE[cache_key]
 
 
-def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
-                     mesh: Mesh, collect_events: bool = True,
-                     total_time: Optional[int] = None, telemetry=None):
-    n = params.EN_GPSZ
-    d = mesh.size
-    if n % d != 0:
-        raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
-    n_local = n // d
-    fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
-    scn_prog = getattr(plan, "scenario", None)
+def sharded_config(params: Params, collect_events: bool, fail_ids: tuple,
+                   scenario, n_local: int) -> HashConfig:
+    """``make_config`` + the per-shard structural re-validation, as one
+    function: make_config checked the GLOBAL shapes; the folded planes /
+    kernel row blocks cover the LOCAL rows here.  A violated path that
+    the user PINNED on (knob 1) raises loudly; one the fusegate
+    auto-enabled (knob -1, resolved against global shapes only) silently
+    downgrades to the jnp path — auto never raises.
+
+    Single-sourced so the service daemon's live-injection recompile
+    (service/daemon._make_hook) builds EXACTLY the config this batch
+    entrypoint runs — same downgrade decisions, same cache key shape."""
     cfg = make_config(params, collect_events, fail_ids=fail_ids,
-                      scenario=None if scn_prog is None
-                      else scn_prog.static)
-    scn_extra = () if scn_prog is None else (scn_prog.tensors(),)
+                      scenario=scenario)
     if cfg.probe_io_lag:
         raise ValueError(
             "PROBE_IO approx_lag is single-chip tpu_hash only (the "
             "sharded twins keep the two-gather attribution)")
 
-    # Per-shard structural re-validation: make_config checked the GLOBAL
-    # shapes; the folded planes / kernel row blocks cover the LOCAL rows
-    # here.  A violated path that the user PINNED on (knob 1) raises
-    # loudly; one the fusegate auto-enabled (knob -1, resolved against
-    # global shapes only) silently downgrades to the jnp path — auto
-    # never raises.
     def _downgrade_or_raise(knob: int, msg: str, **off):
         nonlocal cfg
         if knob == -1:
@@ -1564,6 +1558,23 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                     f"(got L={n_local}, S={cfg.s}; need S % 128 == 0 "
                     f"and L >= 8)",
                     fused_receive=False)
+    return cfg
+
+
+def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
+                     mesh: Mesh, collect_events: bool = True,
+                     total_time: Optional[int] = None, telemetry=None):
+    n = params.EN_GPSZ
+    d = mesh.size
+    if n % d != 0:
+        raise ValueError(f"EN_GPSZ={n} not divisible by mesh size {d}")
+    n_local = n // d
+    fail_ids = tuple(plan.failed_indices) if plan.fail_time is not None else ()
+    scn_prog = getattr(plan, "scenario", None)
+    cfg = sharded_config(params, collect_events, fail_ids,
+                         None if scn_prog is None else scn_prog.static,
+                         n_local)
+    scn_extra = () if scn_prog is None else (scn_prog.tensors(),)
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
     warm = params.JOIN_MODE == "warm"

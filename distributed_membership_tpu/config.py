@@ -297,6 +297,27 @@ class Params:
     # already-pulled carry; raise this on very large runs if the
     # boundary-time decode shows up in runlog.jsonl flush_s.
     SERVICE_SNAPSHOT_EVERY: int = 1
+    # Fleet controller (fleet/ package, ``--fleet``): one control-plane
+    # process owning a journaled run registry and a bounded-worker
+    # scheduler, multiplexing many runs (each a subprocess driving the
+    # chunked engine) behind /v1/runs/<id>/.  The FLEET_* keys configure
+    # the CONTROLLER (read from the optional conf given to --fleet);
+    # they are trajectory-inert for any run that carries them.
+    # -1 = off, 0 = ephemeral port (written to <dir>/fleet.json),
+    # 1..65535 = that port.
+    FLEET_PORT: int = -1
+    # Max subprocess workers running concurrently; queued runs wait
+    # FIFO within priority class (lower number = served first).
+    FLEET_MAX_CONCURRENCY: int = 2
+    # Root directory for the fleet: fleet_runs.jsonl (the submission
+    # journal) + one subdirectory per run (conf, checkpoints,
+    # telemetry, artifacts).  '' = the --fleet --out-dir.
+    FLEET_DIR: str = ""
+    # 1 = keep a completed run's worker daemon serving its final
+    # snapshot until killed (tests/bench query completed runs
+    # deterministically); 0 = shut workers down on completion so the
+    # process table holds only ticking runs.
+    FLEET_LINGER: int = 0
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -487,6 +508,17 @@ class Params:
             raise ValueError(
                 f"SERVICE_SNAPSHOT_EVERY must be >= 1 segment "
                 f"boundaries, got {self.SERVICE_SNAPSHOT_EVERY}")
+        if not -1 <= self.FLEET_PORT <= 65535:
+            raise ValueError(
+                f"FLEET_PORT must be -1 (off), 0 (ephemeral) or a "
+                f"port in 1..65535, got {self.FLEET_PORT}")
+        if self.FLEET_MAX_CONCURRENCY < 1:
+            raise ValueError(
+                f"FLEET_MAX_CONCURRENCY must be >= 1 worker, got "
+                f"{self.FLEET_MAX_CONCURRENCY}")
+        if self.FLEET_LINGER not in (0, 1):
+            raise ValueError(
+                f"FLEET_LINGER must be 0 or 1, got {self.FLEET_LINGER!r}")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(

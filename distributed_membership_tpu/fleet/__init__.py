@@ -1,0 +1,11 @@
+"""Fleet controller: one control plane multiplexing many runs.
+
+``python -m distributed_membership_tpu --fleet`` starts a stdlib-only
+daemon that owns a run registry (registry.py: fsync-journaled to
+``fleet_runs.jsonl`` before any submission is acknowledged), a
+bounded-worker scheduler (scheduler.py: each run is the EXISTING
+chunked driver in a subprocess, isolated per-run out/checkpoint/
+telemetry dirs), and an HTTP surface (daemon.py) that proxies the full
+single-run service API under ``/v1/runs/<id>/`` and adds fleet-level
+submit/list/pause/resume/kill/summary endpoints.
+"""

@@ -241,7 +241,15 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=None, metavar="P",
                     help="SERVICE_PORT conf key: port for --serve "
                          "(0 = ephemeral, written to "
-                         "<out-dir>/service.json; default ephemeral)")
+                         "<out-dir>/service.json; default ephemeral); "
+                         "with --fleet it is the FLEET_PORT instead")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet controller (fleet/ package): a "
+                         "control plane scheduling many runs submitted "
+                         "over HTTP (POST /v1/runs) into subprocess "
+                         "workers, proxying each run's --serve surface "
+                         "under /v1/runs/<id>/.  conf is optional and "
+                         "read for FLEET_* keys only")
     ap.add_argument("--platform", default=None, choices=["cpu", "tpu", "axon"],
                     help="pin the jax platform (e.g. cpu for hermetic runs on "
                          "a virtual device mesh)")
@@ -253,10 +261,21 @@ def main(argv=None) -> int:
 
     if args.grade_all:
         return grade_all(args)
-    if args.conf is None:
-        ap.error("conf is required unless --grade-all is given")
-    if args.port is not None and not args.serve:
-        ap.error("--port requires --serve")
+    if args.serve and args.fleet:
+        ap.error("--serve and --fleet are mutually exclusive (submit "
+                 "the run to the fleet instead)")
+    if args.conf is None and not args.fleet:
+        ap.error("conf is required unless --grade-all or --fleet is "
+                 "given")
+    if args.port is not None and not (args.serve or args.fleet):
+        ap.error("--port requires --serve or --fleet")
+
+    if args.fleet:
+        # The controller itself never touches jax — workers are full
+        # CLI subprocesses that resolve their own platform.
+        from distributed_membership_tpu.fleet.daemon import fleet_conf
+        return fleet_conf(args.conf, port=args.port,
+                          out_dir=args.out_dir)
 
     if params_backend_needs_jax(args):
         # An unreachable TPU relay makes the first jax backend init hang

@@ -48,6 +48,11 @@ CKPT_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
 KEEP_CHECKPOINTS = 3       # versioned history depth; older files pruned
 CRASH_ENV = "DM_CRASH_AT_TICK"
+# Boundary state reporting for fleet workers (fleet/scheduler.py sets
+# this): the driver atomically rewrites the named JSON file with
+# {tick, total, ts} at every segment boundary, so a controller can read
+# a HEADLESS worker's progress without an HTTP surface on the worker.
+STATE_FILE_ENV = "DM_RUN_STATE_FILE"
 
 # Fields that do not change what the run computes per tick: the clock
 # (reset by parse), and the checkpoint-control keys themselves — a resume
@@ -65,7 +70,11 @@ _IDENTITY_EXCLUDE = frozenset(
      # queries never touch device state, so a resume may serve on a
      # different port (or not serve at all) without invalidating the
      # run (tests/test_service.py pins serve-on/off bit-exactness).
-     "SERVICE_PORT", "SERVICE_SNAPSHOT_EVERY"})
+     "SERVICE_PORT", "SERVICE_SNAPSHOT_EVERY",
+     # The fleet keys configure the CONTROLLER process, never the run's
+     # per-tick math — a conf submitted to a fleet resumes bit-exactly
+     # under a controller with different scheduling knobs (or none).
+     "FLEET_PORT", "FLEET_MAX_CONCURRENCY", "FLEET_DIR", "FLEET_LINGER"})
 
 
 def params_identity(params: Params) -> str:
@@ -315,6 +324,37 @@ def _crash_tick() -> Optional[int]:
     return int(v) if v else None
 
 
+def _state_reporter(total: int) -> Optional[Callable[[int], None]]:
+    """The fleet worker's progress beacon: a callable writing
+    ``{tick, total, ts}`` to ``$DM_RUN_STATE_FILE`` (atomic rename, so
+    a reader never sees a torn file), or None when the env is unset.
+    Best-effort by design — a full disk must not kill the run over a
+    progress report the checkpoints already imply."""
+    path = os.environ.get(STATE_FILE_ENV)
+    if not path:
+        return None
+
+    def report(tick: int) -> None:
+        def _write(tmp):
+            with open(tmp, "w") as fh:
+                json.dump({"tick": int(tick), "total": int(total),
+                           "ts": time.time()}, fh)
+        try:
+            _atomic_write(path, _write)
+        except OSError:
+            pass
+    return report
+
+
+def read_run_state(path: str) -> Optional[dict]:
+    """The beacon's current value, or None (absent/torn)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 class RunInterrupted(RuntimeError):
     """A graceful stop (SIGTERM/SIGINT, or a boundary hook's ``stop``)
     halted :func:`chunked_run` at a segment boundary.  By the time this
@@ -478,6 +518,9 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
             fut.result()    # surface writer exceptions on the main thread
 
     crash_at = _crash_tick()
+    report_state = _state_reporter(total)
+    if report_state is not None:
+        report_state(start)
     if runlog is not None:
         runlog.event("segments_start", backend=params.BACKEND,
                      total=int(total), every=int(every),
@@ -587,6 +630,8 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                 pending = executor.submit(
                     _save_checkpoint, ckpt_dir, base, b,
                     jax.tree_util.tree_leaves(carry), payload, compress)
+            if report_state is not None:
+                report_state(b)
             if runlog is not None:
                 # Per-boundary attribution: device_sync_s is dispatch +
                 # device compute + the host pull; ckpt_wait_s is write

@@ -7,6 +7,14 @@ recorder for /v1/timeline and /v1/stream) — handler threads never
 touch device state, never block the tick engine, and a torn client
 connection kills only its own thread (BrokenPipe is swallowed).
 
+The route logic lives in module-level functions (:func:`route_get`,
+:func:`route_post`) that take the ControlState and a path with any
+mount prefix ALREADY STRIPPED — so the same handlers answer both the
+single-run daemon's bare paths (``/v1/census``) and the fleet
+controller's prefixed ones (``/v1/runs/<id>/v1/census`` forwards the
+stripped remainder to the run's worker daemon, whose handlers are
+these very functions; fleet/daemon.py never re-implements a route).
+
 Endpoints (README "Service"):
 
   GET  /healthz               liveness + run phase + snapshot tick
@@ -22,6 +30,7 @@ Endpoints (README "Service"):
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import time
@@ -29,6 +38,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 SSE_POLL_SECONDS = 0.25
+
+
+class PortInUseError(OSError):
+    """``bind()`` failed with EADDRINUSE — the CLI entries turn this
+    into a run-dir hint + exit 2 instead of a raw traceback."""
+
+    def __init__(self, port: int):
+        super().__init__(errno.EADDRINUSE,
+                         f"port {port} is already in use")
+        self.port = port
 
 
 def _timeline_rows(path: str, start: int):
@@ -47,143 +66,194 @@ def _timeline_rows(path: str, start: int):
     return rows
 
 
+class ApiHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing for the service AND fleet servers.
+
+    Subclasses implement ``_route_get``/``_route_post``; everything
+    transport-level (keep-alive, Nagle, JSON replies, torn-client
+    tolerance) lives here once.
+    """
+
+    # Content-Length is set on every JSON reply, so keep-alive is
+    # safe — and it is what lets the bench's 8 query clients reuse
+    # connections instead of paying a TCP handshake per query.
+    protocol_version = "HTTP/1.1"
+    # Every reply is two small writes on an unbuffered wfile (the
+    # header buffer flush, then the body); with Nagle on, the body
+    # write sits behind the peer's delayed ACK — a ~40 ms stall per
+    # request that caps one keep-alive client near 25 queries/s.
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):   # stdlib default is stderr
+        pass
+
+    def _json(self, code: int, obj: dict) -> None:
+        self._body(code, (json.dumps(obj) + "\n").encode())
+
+    def _body(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_json_body(self):
+        """→ parsed JSON body, or None after replying 400."""
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            return json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError as e:
+            self._json(400, {"error": f"invalid JSON ({e})"})
+            return None
+
+    def do_GET(self):
+        try:
+            self._route_get()
+        except (BrokenPipeError, ConnectionResetError):
+            pass            # client went away; its thread exits
+
+    def do_POST(self):
+        try:
+            self._route_post()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def route_get(h: ApiHandler, state, upath: str, query: str) -> None:
+    """The run-surface GET routes, mount-point agnostic: ``upath`` has
+    any prefix already stripped.  ``state`` is the daemon's
+    ControlState; ``h`` the handler to reply on."""
+    state.count_query()
+
+    def _snapshot():
+        snap = state.store.get()
+        if snap is None:
+            h._json(503, {"error": "no snapshot published yet"})
+        return snap
+
+    if upath == "/healthz":
+        h._json(200, state.health())
+    elif upath == "/v1/census":
+        snap = _snapshot()
+        if snap is not None:
+            h._body(200, snap.census_json())
+    elif upath.startswith("/v1/member/"):
+        snap = _snapshot()
+        if snap is None:
+            return
+        try:
+            i = int(upath[len("/v1/member/"):])
+        except ValueError:
+            h._json(400, {"error": "member id must be an int"})
+            return
+        if not 0 <= i < snap.n:
+            h._json(404, {"error": f"member {i} out of range "
+                                   f"[0, {snap.n})"})
+            return
+        h._json(200, snap.member(i))
+    elif upath == "/v1/timeline":
+        path = state.timeline_path()
+        if not path or not os.path.exists(path):
+            h._json(404, {"error": "no timeline (run with "
+                                   "TELEMETRY scalars and a "
+                                   "TELEMETRY_DIR)"})
+            return
+        q = parse_qs(query)
+        start = int(q.get("from", ["0"])[0])
+        h._json(200, {"from": start,
+                      "rows": _timeline_rows(path, start)})
+    elif upath == "/v1/stream":
+        stream(h, state)
+    else:
+        h._json(404, {"error": f"unknown path {upath!r}"})
+
+
+def route_post(h: ApiHandler, state, upath: str) -> None:
+    """The run-surface POST routes (same stripping contract as
+    :func:`route_get`)."""
+    if upath == "/v1/events":
+        body = h.read_json_body()
+        if body is None:
+            return
+        events = (body.get("events", [body])
+                  if isinstance(body, dict) else body)
+        code, reply = state.inject(events)
+        h._json(code, reply)
+    elif upath == "/v1/admin/checkpoint":
+        code, reply = state.checkpoint_barrier()
+        h._json(code, reply)
+    elif upath == "/v1/admin/shutdown":
+        state.request_shutdown()
+        h._json(200, {"stopping": True,
+                      "status": state.status})
+    else:
+        h._json(404, {"error": f"unknown path {upath!r}"})
+
+
+def stream(h: ApiHandler, state) -> None:
+    """SSE: per-tick telemetry scalars as they reach the on-disk
+    timeline, one ``data:`` message per tick.  The loop ends when the
+    client disconnects (a write raises) or the daemon stops.  Idle
+    polls write an SSE comment keepalive — without it a disconnected
+    client is only noticed at the next data row, so a stream opened
+    against a paused run would pin its handler thread (and the
+    socket) until the daemon exits."""
+    path = state.timeline_path()
+    if not path:
+        h._json(404, {"error": "no telemetry stream (run "
+                               "with TELEMETRY scalars and "
+                               "a TELEMETRY_DIR)"})
+        return
+    h.send_response(200)
+    h.send_header("Content-Type", "text/event-stream")
+    h.send_header("Cache-Control", "no-cache")
+    h.send_header("Connection", "close")
+    h.end_headers()
+    sent_to = 0
+    while not state.stopped():
+        wrote = False
+        if os.path.exists(path):
+            for row in _timeline_rows(path, sent_to):
+                msg = f"data: {json.dumps(row)}\n\n".encode()
+                h.wfile.write(msg)
+                sent_to = row["t"] + 1
+                wrote = True
+        if state.run_complete() and sent_to >= state.total:
+            break
+        if not wrote:
+            # Keepalive comment: detects a gone client within one
+            # poll period even when no new ticks are flowing.
+            h.wfile.write(b": keepalive\n\n")
+        h.wfile.flush()
+        time.sleep(SSE_POLL_SECONDS)
+
+
+def bind_server(handler_cls, port: int,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Bind (not start) a threaded server; EADDRINUSE becomes the
+    typed :class:`PortInUseError` the CLI entries catch."""
+    try:
+        server = ThreadingHTTPServer((host, port), handler_cls)
+    except OSError as e:
+        if e.errno == errno.EADDRINUSE:
+            raise PortInUseError(port) from e
+        raise
+    server.daemon_threads = True
+    return server
+
+
 def make_server(state, port: int) -> ThreadingHTTPServer:
     """Build (not start) the API server bound to 127.0.0.1:``port``
     (0 = ephemeral).  ``state`` is the daemon's ControlState."""
 
-    class Handler(BaseHTTPRequestHandler):
-        # Content-Length is set on every JSON reply, so keep-alive is
-        # safe — and it is what lets the bench's 8 query clients reuse
-        # connections instead of paying a TCP handshake per query.
-        protocol_version = "HTTP/1.1"
-        # Every reply is two small writes on an unbuffered wfile (the
-        # header buffer flush, then the body); with Nagle on, the body
-        # write sits behind the peer's delayed ACK — a ~40 ms stall per
-        # request that caps one keep-alive client near 25 queries/s.
-        disable_nagle_algorithm = True
-
-        def log_message(self, fmt, *args):   # stdlib default is stderr
-            pass
-
-        def _json(self, code: int, obj: dict) -> None:
-            self._body(code, (json.dumps(obj) + "\n").encode())
-
-        def _body(self, code: int, body: bytes) -> None:
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def _snapshot(self):
-            snap = state.store.get()
-            if snap is None:
-                self._json(503, {"error": "no snapshot published yet"})
-            return snap
-
-        def do_GET(self):
-            try:
-                self._route_get()
-            except (BrokenPipeError, ConnectionResetError):
-                pass            # client went away; its thread exits
-
-        def do_POST(self):
-            try:
-                self._route_post()
-            except (BrokenPipeError, ConnectionResetError):
-                pass
-
+    class Handler(ApiHandler):
         def _route_get(self):
             # partition, not urlparse: census/member are the bench's
             # hot path and carry no query string.
             upath, _, query = self.path.partition("?")
-            state.count_query()
-            if upath == "/healthz":
-                self._json(200, state.health())
-            elif upath == "/v1/census":
-                snap = self._snapshot()
-                if snap is not None:
-                    self._body(200, snap.census_json())
-            elif upath.startswith("/v1/member/"):
-                snap = self._snapshot()
-                if snap is None:
-                    return
-                try:
-                    i = int(upath[len("/v1/member/"):])
-                except ValueError:
-                    self._json(400, {"error": "member id must be an int"})
-                    return
-                if not 0 <= i < snap.n:
-                    self._json(404, {"error": f"member {i} out of range "
-                                              f"[0, {snap.n})"})
-                    return
-                self._json(200, snap.member(i))
-            elif upath == "/v1/timeline":
-                path = state.timeline_path()
-                if not path or not os.path.exists(path):
-                    self._json(404, {"error": "no timeline (run with "
-                                              "TELEMETRY scalars and a "
-                                              "TELEMETRY_DIR)"})
-                    return
-                q = parse_qs(query)
-                start = int(q.get("from", ["0"])[0])
-                self._json(200, {"from": start,
-                                 "rows": _timeline_rows(path, start)})
-            elif upath == "/v1/stream":
-                self._stream()
-            else:
-                self._json(404, {"error": f"unknown path {upath!r}"})
+            route_get(self, state, upath, query)
 
         def _route_post(self):
-            if self.path == "/v1/events":
-                length = int(self.headers.get("Content-Length", 0))
-                try:
-                    body = json.loads(self.rfile.read(length) or b"{}")
-                except json.JSONDecodeError as e:
-                    self._json(400, {"error": f"invalid JSON ({e})"})
-                    return
-                events = (body.get("events", [body])
-                          if isinstance(body, dict) else body)
-                code, reply = state.inject(events)
-                self._json(code, reply)
-            elif self.path == "/v1/admin/checkpoint":
-                code, reply = state.checkpoint_barrier()
-                self._json(code, reply)
-            elif self.path == "/v1/admin/shutdown":
-                state.request_shutdown()
-                self._json(200, {"stopping": True,
-                                 "status": state.status})
-            else:
-                self._json(404, {"error": f"unknown path {self.path!r}"})
+            route_post(self, state, self.path)
 
-        def _stream(self):
-            """SSE: per-tick telemetry scalars as they reach the
-            on-disk timeline, one ``data:`` message per tick.  The
-            loop ends when the client disconnects (write raises) or
-            the daemon stops."""
-            path = state.timeline_path()
-            if not path:
-                self._json(404, {"error": "no telemetry stream (run "
-                                          "with TELEMETRY scalars and "
-                                          "a TELEMETRY_DIR)"})
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", "text/event-stream")
-            self.send_header("Cache-Control", "no-cache")
-            self.send_header("Connection", "close")
-            self.end_headers()
-            sent_to = 0
-            while not state.stopped():
-                if os.path.exists(path):
-                    for row in _timeline_rows(path, sent_to):
-                        msg = f"data: {json.dumps(row)}\n\n".encode()
-                        self.wfile.write(msg)
-                        sent_to = row["t"] + 1
-                    self.wfile.flush()
-                if state.run_complete() and sent_to >= state.total:
-                    break
-                time.sleep(SSE_POLL_SECONDS)
-
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    server.daemon_threads = True
-    return server
+    return bind_server(Handler, port)

@@ -70,6 +70,11 @@ class ControlState:
         self.stop_event = threading.Event()
         self._lock = threading.Lock()
         self._inject_unsupported = injection_unsupported(params)
+        # The run mesh (tpu_hash_sharded only), resolved ONCE by
+        # serve_run and shared with the injection hook: the recompiled
+        # merged runner must close over the very mesh the engine runs
+        # on, or the swap would silently change the sharding.
+        self.mesh = None
 
     # ---- query side -------------------------------------------------
     def count_query(self) -> None:
@@ -116,8 +121,7 @@ class ControlState:
             return 400, {"error": "body must be an event object or "
                                   "{'events': [...]}"}
         if self._inject_unsupported:
-            code = 501 if self.params.BACKEND == "tpu_hash_sharded" else 409
-            return code, {"error": self._inject_unsupported}
+            return 409, {"error": self._inject_unsupported}
         if self.run_complete():
             return 409, {"error": f"run is {self.status}; no further "
                                   "segments to inject into"}
@@ -191,14 +195,29 @@ def _make_hook(state: ControlState):
             # mutated in place so finish_run's tail (dbg lines, oracle)
             # matches an uninterrupted union-scenario run.
             from distributed_membership_tpu.backends.tpu_hash import (
-                _get_segment_runner, make_config, plan_fail_ids)
+                plan_fail_ids)
             apply_merge(params, state.plan, state.base_events,
                         state.applied, state.seed)
-            cfg = make_config(params, collect_events=True,
-                              fail_ids=plan_fail_ids(state.plan),
-                              scenario=state.plan.scenario.static)
-            upd["segment_fn"] = _get_segment_runner(
-                cfg, params.JOIN_MODE == "warm")
+            warm = params.JOIN_MODE == "warm"
+            if params.BACKEND == "tpu_hash_sharded":
+                # EVENT_MODE full (the injection gate) means the
+                # segment runner needs no agg-merge adapter — the raw
+                # shard_map runner slots straight into chunked_run.
+                from distributed_membership_tpu.backends.tpu_hash_sharded \
+                    import _get_segment_runner, sharded_config
+                n_local = n // state.mesh.size
+                cfg = sharded_config(
+                    params, True, plan_fail_ids(state.plan),
+                    state.plan.scenario.static, n_local)
+                upd["segment_fn"] = _get_segment_runner(
+                    cfg, n_local, state.mesh, warm)
+            else:
+                from distributed_membership_tpu.backends.tpu_hash import (
+                    _get_segment_runner, make_config)
+                cfg = make_config(params, collect_events=True,
+                                  fail_ids=plan_fail_ids(state.plan),
+                                  scenario=state.plan.scenario.static)
+                upd["segment_fn"] = _get_segment_runner(cfg, warm)
             upd["extra_inputs"] = (state.plan.scenario.tensors(),)
         if state.stop_event.is_set():
             upd["stop"] = True
@@ -208,21 +227,41 @@ def _make_hook(state: ControlState):
 
 
 def _run_backend(params: Params, plan, log: EventLog, seed: int,
-                 t0: float):
+                 t0: float, mesh=None):
     """The backend entrypoint tail, with the resolved plan held by the
     CALLER (so the boundary hook can mutate it) — otherwise identical
-    to run_tpu_hash / run_tpu_hash_sharded."""
+    to run_tpu_hash / run_tpu_hash_sharded.  ``mesh`` lets serve_run
+    pass the mesh it already resolved for the injection hook."""
     from distributed_membership_tpu.backends.tpu_sparse import finish_run
     if params.BACKEND == "tpu_hash_sharded":
         from distributed_membership_tpu.backends.tpu_hash_sharded import (
             bind_run_scan, resolve_mesh)
-        mesh = resolve_mesh(params)
+        mesh = resolve_mesh(params, mesh)
         result = finish_run(params, plan, log, bind_run_scan(mesh), t0,
                             seed)
         result.extra["mesh_size"] = mesh.size
         return result
     from distributed_membership_tpu.backends.tpu_hash import run_scan
     return finish_run(params, plan, log, run_scan, t0, seed)
+
+
+def port_in_use_hint(err, out_dir: str) -> str:
+    """Operator-facing message for a bind failure: name the run dir
+    that owns the port when its discovery file says so (the common
+    collision is re-serving an out-dir whose daemon is still up)."""
+    lines = [f"service: cannot bind — {err.strerror}; pick another "
+             "--port (or 0 for ephemeral), or stop the owner"]
+    try:
+        with open(os.path.join(out_dir, SERVICE_JSON)) as fh:
+            info = json.load(fh)
+        if info.get("port") == err.port:
+            lines.append(
+                f"service: {SERVICE_JSON} in {out_dir!r} records pid "
+                f"{info.get('pid')} serving this run dir on port "
+                f"{err.port} — that daemon likely still owns it")
+    except (OSError, ValueError):
+        pass
+    return "\n".join(lines)
 
 
 def _write_service_json(out_dir: str, state: ControlState) -> None:
@@ -290,6 +329,10 @@ def serve_run(params: Params, seed: Optional[int] = None,
 
     state = ControlState(params, plan, seed, params.TOTAL_TIME, journal,
                          base_evs)
+    if params.BACKEND == "tpu_hash_sharded":
+        from distributed_membership_tpu.backends.tpu_hash_sharded import (
+            resolve_mesh)
+        state.mesh = resolve_mesh(params)
     if journal is not None:
         if params.RESUME:
             # Replay acknowledged injections BEFORE the first segment:
@@ -316,7 +359,8 @@ def serve_run(params: Params, seed: Optional[int] = None,
         try:
             with boundary_hook(_make_hook(state)):
                 state.status = "running"
-                result = _run_backend(params, plan, log, seed, t0)
+                result = _run_backend(params, plan, log, seed, t0,
+                                      mesh=state.mesh)
         except RunInterrupted as e:
             state.status = "interrupted"
             print(f"service: {e} — resume with --resume", flush=True)
@@ -344,6 +388,9 @@ def serve_conf(conf_path: str, port: Optional[int] = None,
     arm SERVICE_PORT, validate, then :func:`serve_run`."""
     from distributed_membership_tpu.runtime.application import (
         apply_overrides)
+    import sys
+
+    from distributed_membership_tpu.service.api import PortInUseError
     seed = overrides.pop("seed", None)
     params = Params.from_file(conf_path, validate=False)
     apply_overrides(params, **overrides)
@@ -352,4 +399,8 @@ def serve_conf(conf_path: str, port: Optional[int] = None,
     elif params.SERVICE_PORT < 0:
         params.SERVICE_PORT = 0       # --serve alone: ephemeral port
     params.validate()
-    return serve_run(params, seed=seed, out_dir=out_dir)
+    try:
+        return serve_run(params, seed=seed, out_dir=out_dir)
+    except PortInUseError as e:
+        print(port_in_use_hint(e, out_dir), file=sys.stderr, flush=True)
+        return 2

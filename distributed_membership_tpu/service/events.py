@@ -44,17 +44,19 @@ def injection_unsupported(params: Params) -> Optional[str]:
 
     Narrower than serving itself: queries work on both ring-family
     backends in either event mode, but swapping the segment runner
-    mid-run needs (a) the single-chip tpu_hash scan (the sharded
-    runner is bound to a mesh closure — ROADMAP open item), (b) the
-    ring exchange (make_config rejects general scenarios on scatter),
-    and (c) EVENT_MODE full — the aggregate carry bakes the static
-    failed-id set (FastAgg) into its shapes, which an injected crash
-    would have to reshape mid-run.
+    mid-run needs (a) a hash-twin scan — single-chip tpu_hash, or
+    tpu_hash_sharded, whose merged runner the daemon rebuilds against
+    the SAME mesh via ``sharded_config`` so the swapped shard_map
+    program is exactly what an uninterrupted union-scenario run
+    compiles — (b) the ring exchange (make_config rejects general
+    scenarios on scatter), and (c) EVENT_MODE full — the aggregate
+    carry bakes the static failed-id set (FastAgg) into its shapes,
+    which an injected crash would have to reshape mid-run.
     """
-    if params.BACKEND != "tpu_hash":
-        return ("live injection is implemented on BACKEND tpu_hash "
-                f"only (got {params.BACKEND!r}; sharded injection is a "
-                "ROADMAP open item)")
+    if params.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
+        return ("live injection is implemented on the hash twins only "
+                "(BACKEND tpu_hash / tpu_hash_sharded; got "
+                f"{params.BACKEND!r})")
     if params.resolved_exchange() != "ring":
         return ("live injection requires the ring exchange (the "
                 "scatter lowering runs legacy-shaped plans only)")

@@ -27,12 +27,19 @@ separator banner otherwise) until Ctrl-C.  The readers are all
 torn-line tolerant, so watching a directory the run is actively
 appending to is safe.
 
+``--dir`` pointed at a FLEET root (a directory holding
+``fleet_runs.jsonl``) switches to the fleet view: one status line per
+run — state, tick progress, live census, SLO verdict — rebuilt from a
+read-only journal replay plus each run dir's beacon/timeline/slo.json.
+Combined with ``--watch`` that is the sweep dashboard.
+
 Usage:
   python scripts/run_report.py --dir <TELEMETRY_DIR>            # markdown
   python scripts/run_report.py --dir <dir> --json               # dict
   python scripts/run_report.py --dir <dir> --out report.md
   python scripts/run_report.py --dir <dir> --slo                # + verdict
   python scripts/run_report.py --dir <dir> --watch --interval 2
+  python scripts/run_report.py --dir <FLEET_DIR> --watch        # fleet view
   python scripts/run_report.py --compare <dirA> <dirB>
   python scripts/run_report.py --ladder artifacts/ladder_events.jsonl
 """
@@ -346,6 +353,102 @@ def render_compare_markdown(cmp: dict) -> str:
     return "\n".join(lines)
 
 
+def is_fleet_root(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, "fleet_runs.jsonl"))
+
+
+def _tail_field(path: str, field: str):
+    """``field`` from the last parseable row of a JSONL file (reads
+    only the tail; torn-tolerant like every reader here)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            fh.seek(max(fh.tell() - 8192, 0))
+            lines = fh.read().decode(errors="replace").splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            return json.loads(line).get(field)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def fleet_report(root: str) -> dict:
+    """Per-run status rows for a fleet root.
+
+    STRICTLY read-only: the controller's own recovery journals
+    transitions, a reporter must not — so this is a local journal
+    replay (last submit/state row wins) refreshed from each run dir's
+    ``run_state.json`` beacon (fresher tick for in-flight workers),
+    ``timeline.jsonl`` tail (live census) and ``slo.json`` (verdict
+    from a prior ``--slo`` pass), never the fleet's HTTP surface — it
+    works on a dead fleet too."""
+    from distributed_membership_tpu.config import Params
+    runs: dict = {}
+    try:
+        with open(os.path.join(root, "fleet_runs.jsonl")) as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        lines = []
+    for line in lines:
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        rid = row.get("run_id")
+        if row.get("kind") == "submit" and rid:
+            total = 0
+            try:
+                total = Params().parse(row.get("conf", ""),
+                                       validate=False).TOTAL_TIME
+            except (TypeError, ValueError):
+                pass
+            runs[rid] = {"run_id": rid, "state": "queued", "tick": 0,
+                         "total": total, "seq": row.get("seq", 0)}
+        elif row.get("kind") == "state" and rid in runs:
+            runs[rid]["state"] = row.get("state", runs[rid]["state"])
+            runs[rid]["tick"] = int(row.get("tick",
+                                            runs[rid]["tick"]))
+    rows = []
+    for rid in sorted(runs, key=lambda r: runs[r]["seq"]):
+        row = runs[rid]
+        run_dir = os.path.join(root, rid)
+        try:
+            with open(os.path.join(run_dir, "run_state.json")) as fh:
+                row["tick"] = max(row["tick"],
+                                  int(json.load(fh).get("tick", 0)))
+        except (OSError, ValueError):
+            pass
+        live = _tail_field(os.path.join(run_dir, TIMELINE_NAME),
+                           "live")
+        if isinstance(live, list):     # chunked rows carry per-tick
+            live = live[-1] if live else None       # lists; tail it
+        row["live"] = live
+        row["slo"] = None
+        try:
+            with open(os.path.join(run_dir, "slo.json")) as fh:
+                row["slo"] = bool(json.load(fh).get("passed"))
+        except (OSError, ValueError):
+            pass
+        rows.append(row)
+    return {"root": root, "runs": rows}
+
+
+def render_fleet(report: dict) -> str:
+    lines = [f"# fleet {report['root']} — {len(report['runs'])} "
+             "run(s)"]
+    for r in report["runs"]:
+        live = "-" if r["live"] is None else str(r["live"])
+        slo = ("-" if r["slo"] is None
+               else "pass" if r["slo"] else "FAIL")
+        lines.append(f"{r['run_id']:<12} {r['state']:<13} "
+                     f"tick {r['tick']:>6}/{r['total']:<6} "
+                     f"live {live:<6} slo {slo}")
+    return "\n".join(lines)
+
+
 def watch(args, iterations: int | None = None) -> int:
     """Poll-and-re-render loop (``--watch``).
 
@@ -353,10 +456,14 @@ def watch(args, iterations: int | None = None) -> int:
     KeyboardInterrupt (exit 0 — stopping a dashboard isn't an error).
     """
     i = 0
+    fleet = bool(args.dir) and is_fleet_root(args.dir)
     try:
         while iterations is None or i < iterations:
-            report = build_report(args.dir, args.ladder, slo=args.slo)
+            report = (fleet_report(args.dir) if fleet else
+                      build_report(args.dir, args.ladder,
+                                   slo=args.slo))
             text = (json.dumps(report, indent=1) if args.json
+                    else render_fleet(report) if fleet
                     else render_markdown(report))
             if sys.stdout.isatty():
                 sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
@@ -424,6 +531,18 @@ def main(argv=None) -> int:
 
     if args.watch:
         return watch(args)
+
+    if args.dir and is_fleet_root(args.dir):
+        report = fleet_report(args.dir)
+        text = (json.dumps(report, indent=1) if args.json
+                else render_fleet(report))
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text + "\n")
+            print(args.out)
+        else:
+            print(text)
+        return 0
 
     report = build_report(args.dir, args.ladder, slo=args.slo)
     if args.slo:

@@ -1,0 +1,519 @@
+"""Fleet controller (fleet/ package).
+
+Pins the control plane's contracts at both granularities:
+
+  * in-process unit coverage of the registry/journal pair — fsync
+    durability before the ACK, torn-line tolerance, garbage-conf
+    refusal, priority+FIFO dispatch order, and crash recovery's
+    journal-replay + disk-probe reconciliation (adopt finished runs,
+    requeue interrupted ones);
+  * subprocess end-to-end coverage of the daemon itself (slow-marked):
+    the max-concurrency cap asserted from the runs listing AND the
+    process table, byte-identical proxying of the single-run surface
+    under ``/v1/runs/<id>/`` with FLEET_LINGER, and the headline crash
+    story — SIGKILL the controller mid-sweep with runs in mixed
+    states, restart, and every run's dbg.log/stats.log comes out
+    byte-identical to an uninterrupted fleet's.
+"""
+
+import http.client
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.fleet import daemon as fleet_daemon
+from distributed_membership_tpu.fleet.registry import (
+    JOURNAL_NAME, FleetJournal, Registry, plan_mode)
+from distributed_membership_tpu.fleet.scheduler import worker_argv
+from distributed_membership_tpu.sweeps import fleet_submit
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# A servable ring conf (same shape as test_service's) and a headless
+# emul conf; TOTAL_TIME is per-test.
+_HASH_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+              "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 1000\n"
+              "JOIN_MODE: warm\nBACKEND: tpu_hash\nEVENT_MODE: full\n"
+              "CHECKPOINT_EVERY: 30\nTELEMETRY: scalars\n")
+_EMUL_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+              "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 50\n"
+              "BACKEND: emul\n")
+
+
+def _hash_conf(total=120):
+    return _HASH_CONF + f"TOTAL_TIME: {total}\n"
+
+
+def _emul_conf(total=150):
+    return _EMUL_CONF + f"TOTAL_TIME: {total}\n"
+
+
+# ---------------------------------------------------------------------------
+# Registry + journal units (fast, in-process)
+
+
+def test_submit_journals_before_ack_and_orders_queue(tmp_path):
+    reg = Registry(str(tmp_path))
+    rec = reg.submit(_emul_conf(), seed=7)
+    # The durable copy hit the journal (fsynced) as part of submit —
+    # the daemon builds its 202 only after this returns.
+    rows = FleetJournal(str(tmp_path / JOURNAL_NAME)).read()
+    assert [r["kind"] for r in rows] == ["submit"]
+    assert rows[0]["run_id"] == rec.run_id == "r0001"
+    assert rows[0]["conf"] == _emul_conf() and rows[0]["seed"] == 7
+    assert rec.state == "queued" and rec.mode == "headless"
+    assert rec.total == 150 and rec.backend == "emul"
+
+    # Dispatch order: priority first, FIFO (seq) within a priority.
+    low = reg.submit(_emul_conf(), priority=5)
+    hot = reg.submit(_emul_conf(), priority=-1)
+    assert [r.run_id for r in reg.queued()] == [
+        hot.run_id, rec.run_id, low.run_id]
+
+    # Refusals never reach the journal.
+    with pytest.raises(ValueError, match="no recognized KEY"):
+        reg.submit("totally not a conf\n")
+    with pytest.raises(ValueError, match="already exists"):
+        reg.submit(_emul_conf(), run_id=rec.run_id)
+    with pytest.raises(ValueError, match="must match"):
+        reg.submit(_emul_conf(), run_id="bad/../id")
+    with pytest.raises(ValueError):          # Params.validate refusal
+        reg.submit("BACKEND: warpdrive\nTOTAL_TIME: 100\n")
+    assert len(reg.journal.read()) == 3
+
+
+def test_recover_replays_probes_and_tolerates_torn_lines(tmp_path):
+    root = str(tmp_path)
+    reg = Registry(root)
+    fin = reg.submit(_emul_conf(), run_id="fin")      # will look done
+    cut = reg.submit(_hash_conf(), run_id="cut")      # interrupted
+    ended = reg.submit(_emul_conf(), run_id="ended")  # terminal state
+    reg.submit(_emul_conf(), run_id="fresh")          # never started
+    reg.set_state(fin, "running", pid=None)
+    reg.set_state(cut, "running", pid=None)
+    reg.set_state(ended, "killed")
+    # "fin" finished on disk but its controller died before journaling
+    # the transition: artifacts are the durable trace for headless.
+    os.makedirs(fin.run_dir(root))
+    with open(os.path.join(fin.run_dir(root), "dbg.log"), "w") as fh:
+        fh.write("x\n")
+    # A torn trailing write (controller died mid-append) must not
+    # poison the replay.
+    with open(os.path.join(root, JOURNAL_NAME), "a") as fh:
+        fh.write('{"kind": "state", "run_id": "cu')
+
+    reg2 = Registry(root)
+    summary = reg2.recover()
+    assert summary == {"adopted": 1, "requeued": 2, "kept": 1}
+    states = {r["run_id"]: r["state"] for r in reg2.listing()}
+    assert states == {"fin": "done", "cut": "queued",
+                      "ended": "killed", "fresh": "queued"}
+    assert reg2.runs["fin"].adopted
+    assert reg2.runs["fin"].tick == reg2.runs["fin"].total
+    # No worker survives a controller death; live fields are cleared.
+    assert reg2.runs["cut"].pid is None
+    # Recovery journaled its own transitions, so a SECOND recovery
+    # reaches the same answer (idempotent restart).
+    reg3 = Registry(root)
+    assert reg3.recover() == {"adopted": 0, "requeued": 2, "kept": 2}
+
+
+@pytest.mark.quick
+def test_plan_mode_matches_worker_capabilities():
+    serve = Params.from_text(_hash_conf())
+    assert plan_mode(serve) == "serve"
+    # Chunkable but not servable (SERVICE_PORT needs the hash twins):
+    # checkpoints still make pause/resume durable.
+    dense = Params.from_text(
+        "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+        "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 120\n"
+        "FAIL_TIME: 50\nBACKEND: tpu\n")
+    assert plan_mode(dense) == "headless-ck"
+    assert plan_mode(Params.from_text(_emul_conf())) == "headless"
+
+
+@pytest.mark.quick
+def test_worker_argv_is_absolute_and_mode_aware(tmp_path):
+    reg = Registry(str(tmp_path))
+    rec = reg.submit(_hash_conf(), run_id="w", scenario=[
+        {"kind": "crash", "time": 70, "nodes": [3]}])
+    argv = worker_argv(rec, str(tmp_path))
+    run_dir = os.path.abspath(os.path.join(str(tmp_path), "w"))
+    # Absolute paths: the argv doubles as the orphan reaper's identity
+    # check across controller restarts from a different cwd.
+    assert os.path.join(run_dir, "run.conf") in argv
+    assert "--resume" in argv and "--serve" in argv
+    assert argv[argv.index("--checkpoint-dir") + 1] == \
+        os.path.join(run_dir, "ck")
+    assert argv[argv.index("--scenario") + 1] == \
+        os.path.join(run_dir, "scenario.json")
+    hl = reg.submit(_emul_conf(), run_id="hl")
+    hl_argv = worker_argv(hl, str(tmp_path))
+    assert "--serve" not in hl_argv and "--resume" not in hl_argv
+
+
+@pytest.mark.quick
+def test_fleet_submit_grid_builder():
+    """The sweep client's grid: overrides replace-or-append conf
+    lines, axes cross-multiply, run ids encode the coordinates."""
+    conf = "BACKEND: emul\nTOTAL_TIME: 150\n"
+    out = fleet_submit.override_conf(conf, "TOTAL_TIME", 99)
+    assert "TOTAL_TIME: 99" in out and "TOTAL_TIME: 150" not in out
+    out = fleet_submit.override_conf(conf, "MSG_DROP_PROB", 0.1)
+    assert out.endswith("MSG_DROP_PROB: 0.1\n")
+    subs = fleet_submit.grid(conf,
+                             {"MSG_DROP_PROB": [0.0, 0.1],
+                              "FAIL_TIME": [40, 60]},
+                             seeds=(1, 2), stem="g")
+    assert len(subs) == 8
+    ids = [s["run_id"] for s in subs]
+    assert len(set(ids)) == 8
+    assert "g-FAIL_TIME-40-MSG_DROP_PROB-0p0-s1" in ids
+    for s in subs:
+        assert "FAIL_TIME: 4" in s["conf"] or "FAIL_TIME: 6" in \
+            s["conf"]
+        assert s["seed"] in (1, 2)
+
+
+def test_run_report_renders_fleet_root(tmp_path):
+    """run_report --dir <fleet root>: one status line per run — tick
+    (journal vs beacon, fresher wins), live census from the timeline
+    tail, SLO verdict from slo.json."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+    root = str(tmp_path)
+    reg = Registry(root)
+    a = reg.submit(_hash_conf(120), run_id="a")
+    reg.submit(_emul_conf(), run_id="b")
+    reg.set_state(a, "running", tick=30)
+    os.makedirs(a.run_dir(root))
+    with open(os.path.join(a.run_dir(root), "run_state.json"),
+              "w") as fh:
+        json.dump({"tick": 60, "total": 120}, fh)   # fresher beacon
+    with open(os.path.join(a.run_dir(root), "timeline.jsonl"),
+              "w") as fh:
+        fh.write(json.dumps({"t0": 0, "ticks": 3,
+                             "live": [16, 16, 15]}) + "\n")
+    with open(os.path.join(a.run_dir(root), "slo.json"), "w") as fh:
+        json.dump({"passed": True, "max_cdf_deviation": 0.01}, fh)
+
+    assert run_report.is_fleet_root(root)
+    assert not run_report.is_fleet_root(str(tmp_path / "a"))
+    report = run_report.fleet_report(root)
+    rows = {r["run_id"]: r for r in report["runs"]}
+    assert rows["a"]["tick"] == 60 and rows["a"]["total"] == 120
+    assert rows["a"]["live"] == 15 and rows["a"]["slo"] is True
+    assert rows["b"] == {"run_id": "b", "state": "queued", "tick": 0,
+                         "total": 150, "seq": 2, "live": None,
+                         "slo": None}
+    text = run_report.render_fleet(report)
+    lines = text.splitlines()
+    assert "2 run(s)" in lines[0]
+    assert len(lines) == 3     # one line per run
+    assert "live 15" in lines[1] and "slo pass" in lines[1]
+    assert "slo -" in lines[2]
+
+
+def test_fleet_bind_failure_hints_and_exits_2(tmp_path, capsys):
+    """--fleet on an in-use port: no traceback — a hint naming the
+    owning controller (from fleet.json) and exit code 2."""
+    root = str(tmp_path)
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    with open(os.path.join(root, fleet_daemon.FLEET_JSON), "w") as fh:
+        json.dump({"port": port, "pid": 424242, "root": root}, fh)
+    try:
+        rc = fleet_daemon.fleet_main(root, port=port)
+    finally:
+        blocker.close()
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "cannot bind" in err
+    assert "424242" in err      # the hint names the owning pid
+
+
+# ---------------------------------------------------------------------------
+# Subprocess end-to-end (slow): a real controller multiplexing real
+# workers.
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(REPO) + os.pathsep +
+                         env.get("PYTHONPATH", ""))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+def _req(port, method, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=None if body is None else json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _jget(port, path):
+    code, raw = _req(port, "GET", path)
+    return code, json.loads(raw)
+
+
+def _start_fleet(root, max_concurrency=2, linger=False):
+    conf = os.path.join(root, "fleet.conf")
+    with open(conf, "w") as fh:
+        fh.write(f"FLEET_MAX_CONCURRENCY: {max_concurrency}\n"
+                 f"FLEET_LINGER: {int(linger)}\n")
+    log = open(os.path.join(root, "controller.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_membership_tpu", conf,
+         "--fleet", "--out-dir", root],
+        env=_env(), stdout=log, stderr=subprocess.STDOUT)
+    log.close()
+    deadline = time.monotonic() + 60
+    path = os.path.join(root, fleet_daemon.FLEET_JSON)
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "controller died: " +
+                open(os.path.join(root, "controller.log")).read())
+        try:
+            info = json.load(open(path))
+            if info.get("pid") == proc.pid:
+                return proc, info["port"]
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(0.05)
+    raise TimeoutError("controller never published fleet.json")
+
+
+def _submit(port, conf, run_id, seed=3, scenario=None):
+    body = {"conf": conf, "run_id": run_id, "seed": seed}
+    if scenario is not None:
+        body["scenario"] = scenario
+    code, obj = _req(port, "POST", "/v1/runs", body=body)
+    obj = json.loads(obj)
+    assert code == 202, obj
+    return obj
+
+
+def _listing(port):
+    code, obj = _jget(port, "/v1/runs")
+    assert code == 200
+    return {r["run_id"]: r for r in obj["runs"]}
+
+
+def _wait_states(port, want, timeout=300):
+    """Poll /v1/runs until every run_id maps to a state in ``want``."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        runs = _listing(port)
+        if all(runs[rid]["state"] in states
+               for rid, states in want.items()):
+            return runs
+        time.sleep(0.1)
+    raise TimeoutError(f"states never reached {want}: "
+                       f"{{k: v['state'] for k, v in runs.items()}}")
+
+
+def _worker_pids(root):
+    """Worker processes alive for this fleet root, from the process
+    table (cmdline names ``<root>/<id>/run.conf``)."""
+    marker = os.path.abspath(root) + os.sep
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmd = fh.read().decode(errors="replace")
+        except OSError:
+            continue
+        if marker in cmd and "run.conf" in cmd:
+            pids.append(int(pid))
+    return pids
+
+
+def _stop_fleet(proc, port):
+    try:
+        _req(port, "POST", "/v1/admin/shutdown")
+    except OSError:
+        pass
+    proc.wait(timeout=60)
+
+
+@pytest.mark.slow
+def test_scheduler_honors_max_concurrency(tmp_path):
+    """Limit 2, 4 submitted: never more than 2 workers alive — from
+    the runs listing AND the process table — and the cap binds (a run
+    queued while 2 run) before everything completes."""
+    root = str(tmp_path)
+    proc, port = _start_fleet(root, max_concurrency=2)
+    try:
+        # Submit through the sweep client: a 2x2 grid of full runs.
+        subs = fleet_submit.grid(_emul_conf(),
+                                 {"FAIL_TIME": [40, 50]},
+                                 seeds=(1, 2), stem="c")
+        assert len(subs) == 4
+        acks = fleet_submit.submit_grid(port, subs)
+        ids = [a["run_id"] for a in acks]
+        max_running = max_procs = 0
+        cap_bound = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            runs = _listing(port)
+            states = [r["state"] for r in runs.values()]
+            running = states.count("running")
+            max_running = max(max_running, running)
+            max_procs = max(max_procs, len(_worker_pids(root)))
+            if running == 2 and "queued" in states:
+                cap_bound = True
+            if all(s == "done" for s in states):
+                break
+            time.sleep(0.05)
+        runs = _listing(port)
+        assert all(r["state"] == "done" for r in runs.values()), runs
+        assert max_running <= 2, f"listing saw {max_running} running"
+        assert max_procs <= 2, f"process table saw {max_procs} workers"
+        assert cap_bound, "cap never bound (runs too fast to overlap?)"
+        # Headless completion was adopted from artifacts, and the
+        # sweep client's wait sees the same terminal grid.
+        for rid in ids:
+            assert os.path.exists(os.path.join(root, rid, "dbg.log"))
+        rows = fleet_submit.wait_grid(port, ids, timeout=30)
+        assert all(r["state"] == "done" for r in rows.values())
+        code, summary = _jget(port, "/v1/fleet/summary")
+        assert code == 200
+        assert summary["aggregate"]["states"] == {"done": 4}
+    finally:
+        _stop_fleet(proc, port)
+
+
+@pytest.mark.slow
+def test_prefix_proxies_single_run_surface_byte_identically(tmp_path):
+    """FLEET_LINGER keeps a finished worker serving its final
+    snapshot: every PR-6 endpoint must answer byte-identically via the
+    /v1/runs/<id>/ prefix and via the worker's own port — the proxy
+    forwards to the same shared handlers, it re-implements nothing."""
+    root = str(tmp_path)
+    proc, port = _start_fleet(root, max_concurrency=1, linger=True)
+    try:
+        _submit(port, _hash_conf(120), "p0")
+        runs = _wait_states(port, {"p0": {"done"}})
+        wport = runs["p0"].get("port")
+        assert wport, "lingering worker published no port"
+        for path in ("/v1/census", "/v1/member/3", "/v1/timeline",
+                     "/v1/timeline?from=5", "/v1/nonexistent"):
+            direct = _req(wport, "GET", path)
+            proxied = _req(port, "GET", "/v1/runs/p0" + path)
+            assert direct == proxied, path
+        # /healthz is the one endpoint with per-request counters
+        # (queries_served, snapshot_age_s): strip those, the rest must
+        # agree field-for-field.
+        def strip(resp):
+            code, raw = resp
+            doc = json.loads(raw)
+            doc.pop("queries_served", None)
+            doc.pop("snapshot_age_s", None)
+            return code, doc
+        assert strip(_req(wport, "GET", "/healthz")) == \
+            strip(_req(port, "GET", "/v1/runs/p0/healthz"))
+        # POSTs too (the run is complete, both sides refuse alike).
+        body = {"kind": "crash", "time": 70, "nodes": [3]}
+        direct = _req(wport, "POST", "/v1/events", body=body)
+        proxied = _req(port, "POST", "/v1/runs/p0/v1/events",
+                       body=body)
+        assert direct == proxied and direct[0] == 409
+        # kill on a lingering run stops the server, run stays done.
+        code, obj = _req(port, "POST", "/v1/runs/p0/kill")
+        assert code == 202 and json.loads(obj)["stopped_linger"]
+        runs = _wait_states(port, {"p0": {"done"}})
+        # With the worker gone the proxy 409s but timeline falls back
+        # to the flight recorder on disk.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            code, _ = _req(port, "GET", "/v1/runs/p0/healthz")
+            if code == 409:
+                break
+            time.sleep(0.1)
+        assert code == 409
+        code, obj = _jget(port, "/v1/runs/p0/v1/timeline")
+        assert code == 200 and obj["rows"]
+    finally:
+        _stop_fleet(proc, port)
+
+
+@pytest.mark.slow
+def test_sigkill_recovery_is_bit_exact(tmp_path):
+    """The headline property: SIGKILL the controller mid-sweep (two
+    runs in flight, one queued), restart it, and the fleet finishes
+    with per-run dbg.log/stats.log byte-identical to an uninterrupted
+    fleet given the same submissions."""
+    subs = [("a", _hash_conf(4000), 3), ("b", _hash_conf(4000), 4),
+            ("c", _hash_conf(120), 5)]
+
+    def run_fleet(root, interrupt):
+        os.makedirs(root, exist_ok=True)
+        proc, port = _start_fleet(root, max_concurrency=2)
+        try:
+            for rid, conf, seed in subs:
+                _submit(port, conf, rid, seed=seed)
+            if interrupt:
+                # Mixed states: a+b running with durable progress
+                # (beacon tick > 0 means at least one checkpoint
+                # boundary passed), c still queued behind the cap.
+                deadline = time.monotonic() + 300
+                while time.monotonic() < deadline:
+                    runs = _listing(port)
+                    if (all(runs[r]["state"] == "running" and
+                            runs[r]["tick"] > 0 for r in ("a", "b"))
+                            and runs["c"]["state"] == "queued"):
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise TimeoutError(f"mixed states never reached: "
+                                       f"{_listing(port)}")
+                proc.kill()                      # SIGKILL, no goodbye
+                proc.wait(timeout=30)
+                # Restart IS recovery: reap orphans, replay journal,
+                # requeue, finish the sweep.
+                proc, port = _start_fleet(root, max_concurrency=2)
+            _wait_states(port, {rid: {"done"} for rid, _, _ in subs})
+        finally:
+            _stop_fleet(proc, port)
+
+    run_fleet(str(tmp_path / "gold"), interrupt=False)
+    run_fleet(str(tmp_path / "crashed"), interrupt=True)
+
+    log = open(os.path.join(str(tmp_path / "crashed"),
+                            "controller.log")).read()
+    assert "journal replayed" in log
+    for rid, _, _ in subs:
+        for art in ("dbg.log", "stats.log"):
+            gold = open(os.path.join(str(tmp_path / "gold"), rid,
+                                     art), "rb").read()
+            crashed = open(os.path.join(str(tmp_path / "crashed"),
+                                        rid, art), "rb").read()
+            assert gold == crashed, f"{rid}/{art} diverged"
+    # The interrupted runs really were resumed, not re-run from
+    # scratch: their journals record a running->queued round trip.
+    journal = FleetJournal(os.path.join(
+        str(tmp_path / "crashed"), JOURNAL_NAME)).read()
+    for rid in ("a", "b"):
+        states = [r["state"] for r in journal
+                  if r.get("kind") == "state" and r["run_id"] == rid]
+        assert states.count("running") >= 2, states

@@ -505,11 +505,12 @@ def test_injection_gates():
     st.status = "complete"
     assert st.inject([ok])[0] == 409
 
-    # Sharded backend → 501 (ROADMAP open item), other gates → 409.
+    # Sharded backend is a first-class injection target now; a
+    # non-hash backend and the other gates → 409.
     sharded = Params.from_text(base.replace("BACKEND: tpu_hash",
                                             "BACKEND: tpu_hash_sharded"))
     code, reply = _state_for(sharded).inject([ok])
-    assert code == 501 and "sharded" in reply["error"]
+    assert code == 202
     agg = Params.from_text(base.replace("EVENT_MODE: full",
                                         "EVENT_MODE: agg"))
     code, reply = _state_for(agg).inject([ok])
@@ -526,3 +527,145 @@ def test_params_identity_excludes_service_keys():
     p2 = Params.from_text(base + "SERVICE_PORT: 8080\n"
                                  "SERVICE_SNAPSHOT_EVERY: 4\n")
     assert ck.params_identity(p1) == ck.params_identity(p2)
+
+
+def test_params_identity_excludes_fleet_keys():
+    # Same contract as the service keys: the fleet keys configure the
+    # CONTROLLER, so a run adopted into (or out of) a fleet must
+    # checkpoint-match its standalone twin.
+    base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 100\n"
+            "JOIN_MODE: warm\nBACKEND: tpu_hash\nCHECKPOINT_EVERY: 25\n")
+    p1 = Params.from_text(base)
+    p2 = Params.from_text(base + "FLEET_PORT: 9100\n"
+                                 "FLEET_MAX_CONCURRENCY: 7\n"
+                                 "FLEET_LINGER: 1\n")
+    assert ck.params_identity(p1) == ck.params_identity(p2)
+
+
+# ---------------------------------------------------------------------------
+# Bind failure UX: EADDRINUSE → owner hint + exit 2, never a traceback
+
+
+def test_serve_bind_failure_hints_and_exits_2(tmp_path, capsys):
+    taken = socket.socket()
+    taken.bind(("127.0.0.1", 0))
+    taken.listen(1)
+    port = taken.getsockname()[1]
+    try:
+        conf = tmp_path / "bind.conf"
+        conf.write_text(
+            "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 60\n"
+            "FAIL_TIME: 1000\nJOIN_MODE: warm\nBACKEND: tpu_hash\n"
+            "EVENT_MODE: full\nCHECKPOINT_EVERY: 30\n")
+        out = tmp_path / "out"
+        out.mkdir()
+        # A discovery file claiming the port: the hint must name it.
+        (out / SERVICE_JSON).write_text(
+            json.dumps({"port": port, "pid": 12345}))
+        rc = serve_conf(str(conf), port=port, out_dir=str(out))
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "cannot bind" in err
+        assert "12345" in err       # the recorded owner pid
+    finally:
+        taken.close()
+
+
+# ---------------------------------------------------------------------------
+# SSE: a client that disconnects while NO rows are flowing must not
+# wedge the publisher thread (the keepalive comment detects it)
+
+
+def test_sse_disconnect_while_idle_frees_thread(tmp_path, monkeypatch):
+    gates = _gate_boundaries(monkeypatch)
+    p = _svc_params(tmp_path, "sse_idle")
+    out = tmp_path / "sse_idle"
+    out.mkdir()
+
+    def script(port):
+        _wait_health(port, lambda h: h["snapshot_tick"] is not None)
+        # Engine parked at boundary 0: the stream has nothing to send
+        # beyond keepalive comments.
+        before = threading.active_count()
+        s = socket.create_connection(("127.0.0.1", port), timeout=60)
+        s.sendall(b"GET /v1/stream HTTP/1.1\r\nHost: t\r\n\r\n")
+        buf = b""
+        while b"text/event-stream" not in buf:
+            buf += s.recv(4096)
+        # Slam shut mid-stream (RST) while the run is parked — before
+        # the keepalive fix this handler thread outlived the whole run.
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if threading.active_count() <= before:
+                break
+            time.sleep(0.1)
+        freed = threading.active_count() <= before
+        # Server is still healthy for fresh connections, run finishes.
+        assert _get(port, "/healthz")[0] == 200
+        for g in gates.values():
+            g.set()
+        _wait_health(port, lambda h: h["status"] == "complete")
+        return freed
+
+    rc, freed = _served(
+        lambda: serve_run(p, seed=SEED, out_dir=str(out)), str(out),
+        script)
+    assert rc == 0
+    assert freed, "SSE handler thread leaked after client disconnect"
+
+
+# ---------------------------------------------------------------------------
+# Sharded live injection: bit-exact vs the uninterrupted twin (N=2048)
+
+
+@pytest.mark.slow
+def test_inject_sharded_bit_exact_vs_union_twin(tmp_path, monkeypatch):
+    """The daemon rebuilds the sharded segment runner via
+    ``sharded_config`` against the run's own mesh; a sharded run that
+    receives the event LIVE must equal, byte for byte (dbg.log AND
+    timeline), the twin that was handed the union scenario as a file
+    up front — the merged_plan contract, now on the shard_map path.
+    (The twin is sharded too: single-chip and sharded twins agree
+    distributionally, not byte-for-byte — their RNG streams differ by
+    construction, tests/test_hash_sharded.py.)"""
+    conf = ("MAX_NNB: 2048\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 120\n"
+            "FAIL_TIME: 1000\nJOIN_MODE: warm\n"
+            "BACKEND: tpu_hash_sharded\n"
+            "EVENT_MODE: full\nCHECKPOINT_EVERY: 30\n"
+            "TELEMETRY: scalars\n")
+
+    # A: served, event injected over HTTP while the engine is parked.
+    gates = _gate_boundaries(monkeypatch)
+    pa = Params.from_text(conf)
+    pa.CHECKPOINT_DIR = str(tmp_path / "live_ck")
+    pa.TELEMETRY_DIR = str(tmp_path / "live_tl")
+    pa.SERVICE_PORT = 0
+    pa.validate()
+    out_live = tmp_path / "live"
+    out_live.mkdir()
+    rc, reply = _served(
+        lambda: serve_run(pa, seed=SEED, out_dir=str(out_live)),
+        str(out_live),
+        lambda port: _inject_when_ticking(port, gates))
+    assert rc == 0
+    assert reply["journaled"] is True
+
+    # B: headless twin handed the union scenario file up front.
+    scn = tmp_path / "union.json"
+    scn.write_text(json.dumps({"name": "union", "events": [_EVENT]}))
+    conf_file = tmp_path / "twin.conf"
+    conf_file.write_text(conf)
+    r = run_conf(str(conf_file), seed=SEED,
+                 out_dir=str(tmp_path / "twin"),
+                 scenario=str(scn),
+                 telemetry_dir=str(tmp_path / "twin_tl"))
+    assert ((out_live / "dbg.log").read_bytes()
+            == r.log.dbg_text().encode())
+    assert ((tmp_path / "live_tl" / "timeline.jsonl").read_bytes()
+            == (tmp_path / "twin_tl" / "timeline.jsonl").read_bytes())
