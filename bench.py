@@ -27,9 +27,13 @@ Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Env overrides: BENCH_N / BENCH_TICKS / BENCH_VIEW (hash leg; gossip len and
-probes derive from the view size), BENCH_FUSED (off|recv|gossip|both —
-Pallas kernels), BENCH_FOLDED (on = the [N/F, 128] folded layout for
-S < 128), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg seconds),
+probes derive from the view size), BENCH_FUSED
+(off|recv|gossip|both|probe|all — Pallas kernels; 'probe' pins the
+fused probe/agg traversal, 'all' every kernel), BENCH_FOLDED (on = the
+[N/F, 128] folded layout for S < 128), BENCH_FPROBE=1 re-times the
+droppy leg fused-probe-on vs off interleaved (ops/fused_probe; banked
+as bench:live:hash:fprobe), BENCH_DENSE_N, BENCH_TIMEOUT (per-leg
+seconds),
 BENCH_CHECKPOINT=K (+ BENCH_CHECKPOINT_COMPRESS=1) re-times the leg
 chunked with async-written snapshots, BENCH_RNG=1 adds the
 batched-vs-scattered threefry micro (ops/rng_plan) at the leg geometry,
@@ -637,13 +641,19 @@ def _ledger_bank_fleet(row: dict) -> None:
               file=sys.stderr)
 
 
-def _mode_str(frecv, fgossip, folded) -> str:
+def _mode_str(frecv, fgossip, folded, fprobe=False) -> str:
     """One mode vocabulary for live AND banked rows ('folded',
-    'fused:recv|gossip|both', their '+' composition, or 'natural') so
-    identical programs never get distinct labels across code paths."""
-    fused = ("fused:both" if frecv and fgossip else
+    'fused:recv|gossip|both|all', their '+' composition, or 'natural')
+    so identical programs never get distinct labels across code paths.
+    The probe kernel extends it: 'fused:all' is recv+gossip+probe,
+    'fused:probe' the probe traversal alone, and partial pairs compose
+    as 'fused:recv+probe' / 'fused:gossip+probe'."""
+    fused = ("fused:all" if frecv and fgossip and fprobe else
+             "fused:both" if frecv and fgossip else
              "fused:recv" if frecv else
              "fused:gossip" if fgossip else "")
+    if fprobe and not (frecv and fgossip):
+        fused = (fused + "+probe") if fused else "fused:probe"
     if folded:
         return "folded" + (f"+{fused}" if fused else "")
     return fused or "natural"
@@ -669,14 +679,18 @@ def leg_hash(n: int, ticks: int, pin: str | None,
     s = view or int(os.environ.get("BENCH_VIEW", "128"))
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
-    # BENCH_FUSED=recv|gossip|both pins the Pallas kernels on, off pins
-    # them off; the default 'auto' (-1 conf keys) lets the fusegate
-    # enable whatever the banked hardware-correctness record has cleared
-    # (runtime/fusegate.py) — so the bench picks up the fast paths the
-    # moment the chip has proven them, and never ships an unproven one.
+    # BENCH_FUSED=recv|gossip|both|probe|all pins the Pallas kernels on,
+    # off pins them off; the default 'auto' (-1 conf keys) lets the
+    # fusegate enable whatever the banked hardware-correctness record has
+    # cleared (runtime/fusegate.py) — so the bench picks up the fast
+    # paths the moment the chip has proven them, and never ships an
+    # unproven one.  'probe' pins only the fused probe/agg traversal
+    # (ops/fused_probe); 'all' pins receive+gossip+probe together.
     fused = os.environ.get("BENCH_FUSED", "auto")
-    if fused not in ("auto", "off", "recv", "gossip", "both"):
-        raise SystemExit(f"BENCH_FUSED must be auto|off|recv|gossip|both, "
+    if fused not in ("auto", "off", "recv", "gossip", "both", "probe",
+                     "all"):
+        raise SystemExit(f"BENCH_FUSED must be "
+                         f"auto|off|recv|gossip|both|probe|all, "
                          f"got {fused!r}")
     folded = os.environ.get("BENCH_FOLDED", "auto")
     if folded not in ("auto", "off", "on"):
@@ -695,9 +709,11 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         raise SystemExit(f"BENCH_SHIFT_SET must be 0 (off) or 2..64, "
                          f"got {shift_set}")
     fused_keys = (
-        ("FUSED_RECEIVE: -1\nFUSED_GOSSIP: -1\n" if fused == "auto" else
-         f"FUSED_RECEIVE: {int(fused in ('recv', 'both'))}\n"
-         f"FUSED_GOSSIP: {int(fused in ('gossip', 'both'))}\n")
+        ("FUSED_RECEIVE: -1\nFUSED_GOSSIP: -1\nFUSED_PROBE: -1\n"
+         if fused == "auto" else
+         f"FUSED_RECEIVE: {int(fused in ('recv', 'both', 'all'))}\n"
+         f"FUSED_GOSSIP: {int(fused in ('gossip', 'both', 'all'))}\n"
+         f"FUSED_PROBE: {int(fused in ('probe', 'all'))}\n")
         + ("FOLDED: -1\n" if folded == "auto" else
            f"FOLDED: {int(folded == 'on')}\n"))
     geom_text = (
@@ -776,6 +792,44 @@ def leg_hash(n: int, ticks: int, pin: str | None,
             "hist_wall_seconds": round(walls["hist"], 3),
             "hist_overhead_pct": round(
                 100 * (walls["hist"] - walls["base"])
+                / max(walls["base"], 1e-9), 1),
+        })
+    # BENCH_FPROBE=1: price the fused probe/agg traversal
+    # (ops/fused_probe) against the unfused probe pipeline at this leg's
+    # geometry — interleaved best-of-R like the telemetry legs, because
+    # the delta is a few percent of step wall.  Both arms run DROPPY
+    # (window drops armed — the composition the masks-as-inputs design
+    # exists for) with TELEMETRY: hist so the kernel's fused agg+hist
+    # reductions are actually in the step, and with receive/gossip
+    # pinned unfused so the delta isolates the probe traversal.
+    # S < 128 folds (the folded kernel twin); lane-aligned S uses the
+    # natural kernel.  Reported positive = the kernel is faster.
+    if os.environ.get("BENCH_FPROBE", "0") not in ("", "0"):
+        fold_pin = 1 if s < 128 else 0
+        fp_lo, fp_hi = ticks // 6, ticks - ticks // 6
+        droppy_text = (
+            geom_text.replace("DROP_MSG: 0", "DROP_MSG: 1")
+            .replace("MSG_DROP_PROB: 0", "MSG_DROP_PROB: 0.1")
+            + f"DROP_START: {fp_lo}\nDROP_STOP: {fp_hi}\n")
+
+        def _fp_params(on: bool):
+            return Params.from_text(
+                droppy_text + "FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\n"
+                f"FOLDED: {fold_pin}\nFUSED_PROBE: {int(on)}\n"
+                "TELEMETRY: hist\nEVENT_MODE: agg\n" + tail_text)
+
+        p_fp_off, p_fp_on = _fp_params(False), _fp_params(True)
+        plan_fp = make_plan(p_fp_off, _pyrandom.Random("app:0"))
+        reps = int(os.environ.get("BENCH_FPROBE_REPS", "5"))
+        fp_base_wall, _ = _timed_runs(run_scan, p_fp_off, plan_fp, ticks)
+        walls = _interleaved_best(run_scan, ticks, (p_fp_off, plan_fp),
+                                  {"fprobe": (p_fp_on, plan_fp)}, reps,
+                                  fp_base_wall)
+        ckpt_fields.update({
+            "fprobe_unfused_wall_seconds": round(walls["base"], 3),
+            "fprobe_wall_seconds": round(walls["fprobe"], 3),
+            "fprobe_speedup_pct": round(
+                100 * (walls["base"] - walls["fprobe"])
                 / max(walls["base"], 1e-9), 1),
         })
     # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
@@ -890,9 +944,11 @@ def leg_hash(n: int, ticks: int, pin: str | None,
         # The ask travels under "requested".
         "fused_receive": bool(cfg.fused_receive),
         "fused_gossip": bool(cfg.fused_gossip),
+        "fused_probe": bool(cfg.fused_probe),
         "folded": bool(cfg.folded),
         "requested": {"fused": fused, "folded": folded},
-        "mode": (_mode_str(cfg.fused_receive, cfg.fused_gossip, cfg.folded)
+        "mode": (_mode_str(cfg.fused_receive, cfg.fused_gossip, cfg.folded,
+                           cfg.fused_probe)
                  + (f"+sw{cfg.shift_set}" if cfg.shift_set else "")),
         "shift_set": cfg.shift_set,
         "node_ticks_per_sec": round(n * ticks / wall, 1),
@@ -977,7 +1033,7 @@ def _best_banked_tpu(art_dir: str | None = None,
                 gb_tick = passes * r["n"] * s * 4 / 1e9
                 gbps = round(gb_tick * r["ticks"] / r["wall_seconds"], 1)
             mode = _mode_str(r.get("fused"), r.get("fused_gossip"),
-                             r.get("folded"))
+                             r.get("folded"), r.get("fused_probe"))
             if r.get("prng", "threefry2x32") != "threefry2x32":
                 mode += f"+prng:{r['prng']}"
             if r.get("shift_set"):
@@ -1064,6 +1120,23 @@ def _ledger_bank(leg: str, row: dict) -> None:
                 platform=row.get("platform"),
                 knobs={"clients": row.get("service_clients"),
                        "overhead_pct": row.get("service_overhead_pct"),
+                       "ticks": row.get("ticks")},
+                source="bench.py"))
+        if row.get("fprobe_wall_seconds"):
+            # The BENCH_FPROBE companion row: fused-vs-unfused probe
+            # traversal delta (positive = the Pallas kernel wins), keyed
+            # apart so perfdb tracks the kernel's trend independently of
+            # the headline tick rate.
+            rows.append(perfdb.make_row(
+                f"bench:live:{leg}:fprobe",
+                metric="fprobe_speedup_pct",
+                value=row["fprobe_speedup_pct"], n=row.get("n"),
+                s=row.get("view_size"),
+                backend="tpu_hash" if leg == "hash" else "dense",
+                platform=row.get("platform"),
+                knobs={"unfused_wall_seconds":
+                       row.get("fprobe_unfused_wall_seconds"),
+                       "fused_wall_seconds": row.get("fprobe_wall_seconds"),
                        "ticks": row.get("ticks")},
                 source="bench.py"))
         perfdb.append_rows(rows, path)

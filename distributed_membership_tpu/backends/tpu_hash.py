@@ -96,6 +96,8 @@ from distributed_membership_tpu.observability.timeline import (
     build_tick_hist)
 from distributed_membership_tpu.ops.fused_gossip import (
     gossip_fused, gossip_fused_stacked, gossip_fused_supported)
+from distributed_membership_tpu.ops.fused_probe import (
+    probe_fused_supported, probe_window_fused)
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
 from distributed_membership_tpu.ops.rng_plan import RingRng, hash_ring_rng
@@ -330,6 +332,12 @@ class HashConfig:
     fused_gossip: bool = False   # all circulant shifts delivered in one
     #                              Pallas traversal (ops/fused_gossip)
     #                              instead of fanout roll+max passes
+    fused_probe: bool = False    # probe-window read + FastAgg/telemetry
+    #                              hist reductions in ONE Pallas traversal
+    #                              of the post-receive planes
+    #                              (ops/fused_probe); drop coins and
+    #                              scenario cuts stay outside in [N, P]
+    #                              space with the exact unfused streams
     folded: bool = False         # [N/F, 128] folded physical layout for
     #                              S < 128 (backends/tpu_hash_folded.py)
     send_budget: int = 0         # per-tick global send cap modeling
@@ -584,11 +592,13 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # shapes need the two-roll wrapped-row column alignment the
         # single-payload kernel omits (make_config rejects these too;
         # this guards direct make_step callers like the sweep driver).
-        # Static DROPS are fine: they ride the stacked kernel with
-        # pre-masked payloads (step body below).
+        # DROPS, drop windows, and scenario flakes are all fine: the
+        # per-shift keep masks ride the kernel as precomputed inputs
+        # (ops/fused_gossip masks=..., step body below).
         raise ValueError(
             "FUSED_GOSSIP requires a static budget-free config and "
-            f"supported shapes (got N={n}, S={s}, "
+            f"supported shapes (ring mode, S % 128 == 0, "
+            f"(N*STRIDE) % S == 0; got N={n}, S={s}, "
             f"dynamic_knobs={dynamic_knobs}, budget={cfg.send_budget})")
     self_slot_mask = jnp.arange(s, dtype=I32)[None, :] == slot_of(
         cfg, idx, idx)[:, None]                                   # [N, S]
@@ -600,16 +610,15 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # direct constructors must not silently get an empty timeline.
         raise ValueError("cfg.telemetry requires the ring exchange")
     if scenario is not None and (not ring or dynamic_knobs
-                                 or cfg.fused_gossip
                                  or cfg.send_budget > 0):
         # make_config gates these too (this guards direct constructors):
-        # general scenarios are ring-only, and the per-shift partition/
-        # flake masks are incompatible with the single-payload gossip
-        # kernel, the dynamic-knob sweep step, and the sequential send
-        # budget.
+        # general scenarios are ring-only, and incompatible with the
+        # dynamic-knob sweep step and the sequential send budget.
+        # FUSED_GOSSIP composes: the per-shift partition/flake masks ride
+        # the kernel as precomputed mask-stack inputs (ops/fused_gossip).
         raise ValueError(
             "cfg.scenario requires the plain ring exchange (no "
-            "FUSED_GOSSIP, dynamic knobs, or ENFORCE_BUFFSIZE)")
+            "dynamic knobs or ENFORCE_BUFFSIZE)")
 
     rng_build = _ring_rng_builder(cfg, use_drop) if ring else None
 
@@ -976,7 +985,10 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # Budget state (track_budget/budget/used/_budget_take) is
             # initialized before the join section: consumption order is
             # join control, gossip shifts, seed burst, probes.
-            if cfg.fused_gossip and not use_drop and k_max > 0:
+            scenario_cuts_gossip = scenario is not None and (
+                scenario.n_parts or scenario.n_flakes)
+            if (cfg.fused_gossip and not use_drop
+                    and not scenario_cuts_gossip and k_max > 0):
                 # One Pallas traversal for all shifts (ops/fused_gossip):
                 # mail is read+written once; sender rows arrive by
                 # scalar-prefetch block indexing.  Counters reduce to a
@@ -993,36 +1005,49 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     sent_gossip = sent_gossip + cnt
                     recv_add = recv_add + jnp.roll(cnt, shifts[j])
             elif cfg.fused_gossip and k_max > 0:
-                # Lossy configs ride the sharded ring's STACKED kernel
-                # instead: the single-payload kernel cannot replicate the
-                # per-shift host-RNG drop masks, so each shift's payload
-                # is pre-masked outside with the EXACT draws the jnp loop
-                # makes (same fold_in stream — bit-exactness is the
-                # contract) and gossip_fused_stacked absorbs the local
-                # roll + column-align + max tail: ~(3K + 2) mail-sized
-                # passes vs the jnp loop's ~5K.  Widens the fast path to
-                # the msgdrop scenario class (VERDICT r3 "weak" 5).
-                payloads = []
+                # Lossy/scenario configs ride the SAME kernel with the
+                # per-shift keep decisions as a stacked mask input
+                # (ops/fused_gossip masks=...): the kernel cannot
+                # replicate the host-RNG drop/flake streams, so each
+                # shift's mask is computed outside with the EXACT draws
+                # the jnp loop makes (same fold_in stream —
+                # bit-exactness is the contract) and the kernel zeroes
+                # non-kept sender entries in VMEM.  The payload stays the
+                # SINGLE unmasked view: no [K, N, S] payload copies are
+                # materialized, and the counters reduce over the masks
+                # the step had to build anyway.
+                masks = []
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
-                    gossip_coin = ((rng.gossip_u[j].reshape(n, s)
-                                    < p_drop) & drop_active)
-                    if cfg.telemetry:
-                        telem_dropped.append(
-                            (m & gossip_coin).sum(dtype=I32))
-                    m = m & ~gossip_coin
-                    payloads.append(jnp.where(m, view, U32(0)))
+                    if scenario_cuts_gossip:
+                        # Same per-SENDER-row cut/flake math as the jnp
+                        # loop below — elementwise, no gather.
+                        dst_g = jax.lax.rem(idx + shifts[j], n)
+                    if scenario is not None and scenario.n_parts:
+                        m = m & ~cross_group(cuts, idx, dst_g)[:, None]
+                    if use_drop:
+                        if scenario is not None:
+                            p_g = site_p(t, idx, dst_g) \
+                                if scenario.n_flakes else site_p(t, 0, 0)
+                            p_gc = (p_g[:, None]
+                                    if getattr(p_g, "ndim", 0) else p_g)
+                            gossip_coin = (rng.gossip_u[j].reshape(n, s)
+                                           < p_gc)
+                        else:
+                            gossip_coin = ((rng.gossip_u[j].reshape(n, s)
+                                            < p_drop) & drop_active)
+                        if cfg.telemetry:
+                            telem_dropped.append(
+                                (m & gossip_coin).sum(dtype=I32))
+                        m = m & ~gossip_coin
+                    masks.append(m)
                     cnt = m.sum(1, dtype=I32)
                     sent_gossip = sent_gossip + cnt
                     recv_add = recv_add + jnp.roll(cnt, shifts[j])
-                s1s = jax.lax.rem(jax.lax.rem(shifts, s) * cstride, s)
-                # gossip_fused_supported (checked above) implies
-                # (N*STRIDE) % S == 0: single column shift, so the
-                # kernel never reads its wrapped-row s2 operand.
-                mail = gossip_fused_stacked(
-                    n, s, k_max, True,
-                    jax.default_backend() != "tpu", mail,
-                    jnp.stack(payloads), shifts, s1s, s1s)
+                mail = gossip_fused(
+                    n, s, k_max, jax.default_backend() != "tpu",
+                    mail, view, k_eff, shifts,
+                    masks=jnp.stack(masks).astype(I32))
             else:
                 for j in range(k_max):
                     m = keep & (j < k_eff)[:, None]
@@ -1165,6 +1190,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # ---- SWIM round-robin probing (see tpu_sparse docstring) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
+        pfo = None   # FUSED_PROBE kernel outputs (consumed by the agg
+        #              and telemetry blocks below when armed)
         if ring and cfg.probes > 0:
             # Issue this tick's probes: record the occupant ids of the
             # deterministic window (a cyclic P-column band) — the ack
@@ -1177,12 +1204,38 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # band doesn't wrap) instead of rolling the whole [N, S]
             # plane dynamically to read P columns.
             with jax.named_scope(PHASE_PROBE):
-                window = ptr_switch(
-                    ptr, p_cnt, s,
-                    lambda o, v: jnp.roll(v, -o, axis=1)[:, :p_cnt], view)
-                w_pres = window > 0
-                w_id = ((window - U32(1)) % U32(n)).astype(I32)
-                p_valid = w_pres & (w_id != idx[:, None]) & act[:, None]
+                if cfg.fused_probe:
+                    # One Pallas traversal reads the post-receive planes
+                    # once: rolled window ids come out pre-validated
+                    # (occupied, not self, observer act) and the
+                    # FastAgg/hist reductions ride as row partials
+                    # (ops/fused_probe).  Scenario cuts and drop coins
+                    # apply below in [N, P] space with the exact unfused
+                    # streams — every suppressed position is consulted
+                    # nowhere else, so the trajectory is bit-exact.
+                    want_hist = cfg.telemetry and cfg.telemetry_hist
+                    want_agg = cfg.fast_agg and not cfg.collect_events
+                    pfo = probe_window_fused(
+                        n, s, p_cnt, cfg.tfail,
+                        cfg.fail_ids if want_agg else (),
+                        want_hist, want_agg,
+                        jax.default_backend() != "tpu",
+                        t, ptr, jnp.zeros((), I32), view,
+                        view_ts if want_hist else None, act,
+                        rm_ids if want_agg else None)
+                    window_ids = pfo["ids"][:, :p_cnt]
+                    p_valid = window_ids > 0
+                    w_id = jnp.where(p_valid,
+                                     window_ids.astype(I32) - 1, 0)
+                else:
+                    window = ptr_switch(
+                        ptr, p_cnt, s,
+                        lambda o, v: jnp.roll(v, -o, axis=1)[:, :p_cnt],
+                        view)
+                    w_pres = window > 0
+                    w_id = ((window - U32(1)) % U32(n)).astype(I32)
+                    p_valid = (w_pres & (w_id != idx[:, None])
+                               & act[:, None])
                 if scenario is not None and scenario.n_parts:
                     # A probe to a node across the partition never
                     # arrives; cut it at issue time (like the drop
@@ -1375,12 +1428,26 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
             # Scale path: fold events into O(N) on-device aggregates; emit
             # only per-tick scalars so stacked outputs stay O(T).
             if cfg.fast_agg:
+                pre = None
+                if pfo is not None and "rm_cnt" in pfo:
+                    # Partials from the fused probe traversal: integer
+                    # sums/ors are order-free, so these reduce bit-equal
+                    # to the in-place plane passes they replace.
+                    pre = {"rm_total": pfo["rm_cnt"].sum(dtype=I32)}
+                    if cfg.fail_ids:
+                        det_cols = pfo["det_cols"]
+                        pre["det_tick"] = jnp.stack(
+                            [d.sum(dtype=I32) for d in det_cols])
+                        any_rm = det_cols[0][:, 0] > 0
+                        for d in det_cols[1:]:
+                            any_rm = any_rm | (d[:, 0] > 0)
+                        pre["any_true_rm"] = any_rm
                 agg = update_fast_agg(
                     state.agg, t=t, fail_ids=cfg.fail_ids,
                     join_events=join_mask, rm_ids=rm_ids,
                     view_ids=cur_id, view_present=present,
                     fail_time=fail_time, holder_failed=fail_mask,
-                    sent_tick=sent_tick, recv_tick=recv_tick)
+                    sent_tick=sent_tick, recv_tick=recv_tick, pre=pre)
             else:
                 agg = update_agg(
                     state.agg, t=t, join_ids=join_ids, rm_ids=rm_ids,
@@ -1426,12 +1493,19 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
                     # Distribution tier: bucketed one-hot reductions
                     # over the post-receive staleness/occupancy tensors
                     # (observability/timeline.py — shared builders, so
-                    # all four twins emit bit-equal counts).
+                    # all four twins emit bit-equal counts).  With
+                    # FUSED_PROBE the staleness/suspicion counts arrive
+                    # as row partials off the fused traversal instead of
+                    # two more plane passes here.
+                    stale = susp = None
+                    if pfo is not None and "stale_rows" in pfo:
+                        stale = pfo["stale_rows"].sum(axis=0)
+                        susp = pfo["susp_rows"].sum(axis=0)
                     hist = build_tick_hist(
                         difft=difft, present=present, size=size,
                         act=act, t=t, fail_time=fail_time,
                         tfail=cfg.tfail, det_tick=det_tick,
-                        dropped=dropped_tick)
+                        dropped=dropped_tick, stale=stale, susp=susp)
                     return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
@@ -1468,13 +1542,6 @@ def make_config(params: Params, collect_events: bool = True,
                 "SCENARIO general events and ENFORCE_BUFFSIZE are "
                 "incompatible (the sequential send budget does not "
                 "model the per-shift partition/flake masks)")
-        if params.FUSED_GOSSIP == 1:
-            raise ValueError(
-                "SCENARIO general events and FUSED_GOSSIP are "
-                "incompatible (the gossip kernels take one pre-masked "
-                "payload; the partition/flake masks are per shift) — "
-                "leave FUSED_GOSSIP on auto, which keeps it off under "
-                "a scenario")
     if params.PROBE_IO == "approx_lag" and exchange != "ring":
         # Loud-rejection policy of the off-path layouts (the sharded and
         # folded guards): on scatter the lag counting branch is
@@ -1495,8 +1562,8 @@ def make_config(params: Params, collect_events: bool = True,
     # evidence for the family (runtime/fusegate.py; fail closed).  Auto
     # never raises — an unsupported config quietly keeps the jnp path.
     fr_knob, fg_knob = params.FUSED_RECEIVE, params.FUSED_GOSSIP
-    fold_knob = params.FOLDED
-    if -1 in (fr_knob, fg_knob, fold_knob):
+    fp_knob, fold_knob = params.FUSED_PROBE, params.FOLDED
+    if -1 in (fr_knob, fg_knob, fp_knob, fold_knob):
         from distributed_membership_tpu.backends.tpu_hash_folded import (
             folded_supported)
         from distributed_membership_tpu.runtime.fusegate import (
@@ -1539,12 +1606,16 @@ def make_config(params: Params, collect_events: bool = True,
             if fr_knob == -1:
                 fr_knob = int(kernels_ok)
             if fg_knob == -1:
-                # The gossip kernel conflicts with SHIFT_SET and with
-                # general scenarios (loud gates); auto must keep it off
-                # rather than resolve into the error — mirrors the
-                # natural-path guard.
-                fg_knob = int(kernels_ok and not params.SHIFT_SET
-                              and scenario is None)
+                # The gossip kernel conflicts with SHIFT_SET (loud
+                # gate); auto must keep it off rather than resolve into
+                # the error.  Drops and scenario flakes are fine — the
+                # stacked kernel takes per-shift masks/payloads.
+                fg_knob = int(kernels_ok and not params.SHIFT_SET)
+            if fp_knob == -1:
+                fp_knob = int(
+                    eligible and (n * s) // 128 >= 8
+                    and 0 < params.PROBES < s
+                    and cleared(f"folded_fused_probe_s{s}"))
         else:
             if fr_knob == -1:
                 fr_knob = int(
@@ -1552,23 +1623,36 @@ def make_config(params: Params, collect_events: bool = True,
                     and fused_supported(n, s)
                     and cleared("fused_receive", "fused_both"))
             if fg_knob == -1:
-                # Drop-free configs run the single-payload kernel; lossy
-                # ones the stacked variant — each auto-enables only on
-                # ITS OWN banked hardware family (fail closed).
+                # Drop-free configs run the single-payload kernel;
+                # lossy/flaky ones the masks-as-inputs stacked variant —
+                # each auto-enables only on ITS OWN banked hardware
+                # family (fail closed).  A general scenario takes the
+                # masks path unconditionally (its cut/flake masks are
+                # per shift).
                 fg_knob = int(
-                    not params.SHIFT_SET and scenario is None
+                    not params.SHIFT_SET
                     and eligible and exchange == "ring"
                     and gossip_fused_supported(n, s)
                     and send_budget_req == 0
                     and (cleared("fused_gossip", "fused_both")
-                         if params.effective_drop_prob() == 0
+                         if (params.effective_drop_prob() == 0
+                             and scenario is None)
                          else cleared("fused_gossip_drops")))
+            if fp_knob == -1:
+                fp_knob = int(
+                    eligible and exchange == "ring"
+                    and probe_fused_supported(n, s, params.PROBES)
+                    and cleared("fused_probe"))
     fused = bool(fr_knob)
     if fused and exchange != "ring":
         raise ValueError("FUSED_RECEIVE requires the ring exchange")
     fused_g = bool(fg_knob)
     if fused_g and exchange != "ring":
         raise ValueError("FUSED_GOSSIP requires the ring exchange")
+    fused_p = bool(fp_knob)
+    if fused_p and (exchange != "ring" or params.PROBES <= 0):
+        raise ValueError(
+            "FUSED_PROBE requires the ring exchange with PROBES > 0")
     folded = bool(fold_knob)
     if folded:
         from distributed_membership_tpu.backends.tpu_hash_folded import (
@@ -1593,10 +1677,14 @@ def make_config(params: Params, collect_events: bool = True,
         # twins (ops/fused_folded) — including, for gossip, under drops
         # (the stacked-payload kernel takes pre-masked payloads).  The
         # only extra requirement is the row-block tiling minimum.
-        if (fused or fused_g) and (n * s) // 128 < 8:
+        if (fused or fused_g or fused_p) and (n * s) // 128 < 8:
             raise ValueError(
                 f"FOLDED FUSED_* kernels need at least 8 plane rows "
                 f"(N*VIEW_SIZE/128 >= 8; got N={n}, S={s})")
+        if fused_p and not 0 < params.PROBES < s:
+            raise ValueError(
+                f"FUSED_PROBE needs 0 < PROBES < VIEW_SIZE "
+                f"(got PROBES={params.PROBES}, S={s})")
     else:
         if fused and not fused_supported(n, s):
             raise ValueError(
@@ -1607,6 +1695,11 @@ def make_config(params: Params, collect_events: bool = True,
                 f"FUSED_GOSSIP needs VIEW_SIZE % 128 == 0 and "
                 f"(N*STRIDE) % VIEW_SIZE == 0 (got N={n}, S={s}); for "
                 f"S < 128 combine it with FOLDED")
+        if fused_p and not probe_fused_supported(n, s, params.PROBES):
+            raise ValueError(
+                f"FUSED_PROBE needs VIEW_SIZE % 128 == 0, N >= 8 and "
+                f"0 < PROBES < VIEW_SIZE (got N={n}, S={s}, "
+                f"P={params.PROBES}); for S < 128 combine it with FOLDED")
     if params.SHIFT_SET:
         # Loud-rejection policy (same as PROBE_IO approx_lag): off-path
         # layouts must not silently ignore the knob.
@@ -1665,7 +1758,8 @@ def make_config(params: Params, collect_events: bool = True,
                         else params.PROBE_IO == "exact"),
         probe_io_none=params.PROBE_IO == "none",
         probe_io_lag=params.PROBE_IO == "approx_lag",
-        fused_receive=fused, fused_gossip=fused_g, folded=folded,
+        fused_receive=fused, fused_gossip=fused_g, fused_probe=fused_p,
+        folded=folded,
         send_budget=send_budget, shift_set=params.SHIFT_SET,
         # Normalized so configs whose lowering cannot differ share one
         # compiled runner: non-ring paths keep site-local draws

@@ -290,6 +290,75 @@ def _fold_probe_window(n, s, p_cnt, fp, window_idx, rows, t, view, act,
     return ids_new, p_valid, probe_dropped
 
 
+def _fold_probe_window_fused(n, s, p_cnt, window_idx, tfail, fail_ids,
+                             want_hist, want_agg, t, row0, view, view_ts,
+                             actp, rm_ids, node_p, probe_u, p_drop,
+                             use_drop, drop_active, count_dropped=False,
+                             scn_ctx=None):
+    """FUSED_PROBE twin of :func:`_fold_probe_window`: one Pallas
+    traversal (ops/fused_probe) rolls the S-folded window and
+    pre-validates the ids (occupied, not self, observer act) while the
+    FastAgg/hist reductions ride as row partials; the pre-existing
+    ``window_idx`` gather then compacts the VALIDATED plane into the
+    P-folded layout (same gather count as the unfused path).  Scenario
+    cuts and drop coins apply here in P-folded space with the exact
+    unfused streams — suppressed positions are consulted nowhere else,
+    so trajectories are bit-exact.  Returns the unfused triple plus the
+    kernel-output dict for the agg/telemetry blocks."""
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_PROBE)
+    from distributed_membership_tpu.ops.fused_probe import (
+        probe_folded_window_fused)
+
+    with jax.named_scope(PHASE_PROBE):
+        ptr = jax.lax.rem(t * p_cnt, s)
+        pfo = probe_folded_window_fused(
+            n, s, p_cnt, tfail, tuple(fail_ids) if want_agg else (),
+            want_hist, want_agg, jax.default_backend() != "tpu",
+            t, ptr, row0, view, view_ts if want_hist else None,
+            actp, rm_ids if want_agg else None)
+        window = pfo["ids"].reshape(-1)[window_idx]
+        p_valid = window > 0
+        w_id = jnp.where(p_valid, window.astype(I32) - 1, 0)
+        if scn_ctx is not None:
+            from distributed_membership_tpu.scenario.compile import (
+                cross_group)
+            static, scn, cuts = scn_ctx
+            if static.n_parts:
+                p_valid = p_valid & ~cross_group(cuts, node_p, w_id)
+        probe_dropped = None
+        if use_drop:
+            if scn_ctx is not None:
+                from distributed_membership_tpu.scenario.compile import (
+                    site_drop_prob)
+                probe_coin = (probe_u.reshape(p_valid.shape)
+                              < site_drop_prob(static, scn, t, node_p,
+                                               w_id))
+            else:
+                probe_coin = ((probe_u.reshape(p_valid.shape) < p_drop)
+                              & drop_active)
+            if count_dropped:
+                probe_dropped = (p_valid & probe_coin).sum(dtype=I32)
+            p_valid = p_valid & ~probe_coin
+        elif count_dropped:
+            probe_dropped = jnp.zeros((), I32)
+        ids_new = jnp.where(p_valid, w_id.astype(U32) + U32(1), U32(0))
+    return ids_new, p_valid, probe_dropped, pfo
+
+
+def _fused_probe_pre(pfo, fail_ids, rowany):
+    """update_fast_agg ``pre=`` dict from the fused-probe kernel outputs
+    (None passthrough when the kernel did not run / emit agg partials)."""
+    if pfo is None or "rm_cnt" not in pfo:
+        return None
+    pre = {"rm_total": pfo["rm_cnt"].sum(dtype=I32)}
+    if fail_ids:
+        pre["det_tick"] = jnp.stack(
+            [d.sum(dtype=I32) for d in pfo["det_cols"]])
+        pre["any_true_rm"] = rowany(pfo["det_any"] != 0)
+    return pre
+
+
 def make_folded_step(cfg):
     """Per-tick transition on folded state.  Mirrors make_step's ring
     branch (tpu_hash.py) op for op; the warm-inert join machinery is
@@ -545,13 +614,25 @@ def make_folded_step(cfg):
         # ---- SWIM probes (P-folded, shared window issue) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
+        pfo = None
         if p_cnt > 0:
-            ids_new, p_valid, probe_dropped = _fold_probe_window(
-                n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
-                rng.probe_u if use_drop else None, p_drop, use_drop,
-                drop_active, count_dropped=cfg.telemetry,
-                scn_ctx=(None if scenario is None else
-                         (scenario, scn, cuts)))
+            if cfg.fused_probe:
+                (ids_new, p_valid, probe_dropped,
+                 pfo) = _fold_probe_window_fused(
+                    n, s, p_cnt, window_idx, cfg.tfail, cfg.fail_ids,
+                    cfg.telemetry and cfg.telemetry_hist, True, t,
+                    jnp.zeros((), I32), view, view_ts, rep(act), rm_ids,
+                    node_p, rng.probe_u if use_drop else None, p_drop,
+                    use_drop, drop_active, count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts)))
+            else:
+                ids_new, p_valid, probe_dropped = _fold_probe_window(
+                    n, s, p_cnt, fp, window_idx, n, t, view, act, node_p,
+                    rng.probe_u if use_drop else None, p_drop, use_drop,
+                    drop_active, count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts)))
             if cfg.telemetry and probe_dropped is not None:
                 telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
@@ -627,15 +708,17 @@ def make_folded_step(cfg):
         else:
             failed = state.failed | (fail_mask & (t == fail_time))
 
+        pre = _fused_probe_pre(pfo, cfg.fail_ids, rowany)
         agg = update_fast_agg(
             state.agg, t=t, fail_ids=cfg.fail_ids,
             join_events=join_mask, rm_ids=rm_ids,
             view_ids=cur_id, view_present=present,
             fail_time=fail_time, holder_failed=fail_mask,
             sent_tick=sent_tick, recv_tick=recv_tick,
-            row_any=rowany, row_expand=rep)
+            row_any=rowany, row_expand=rep, pre=pre)
         out = SparseTickEvents(join_mask.sum(dtype=I32),
-                               (rm_ids != EMPTY).sum(dtype=I32),
+                               (pre["rm_total"] if pre is not None else
+                                (rm_ids != EMPTY).sum(dtype=I32)),
                                sent_tick.sum(dtype=I32),
                                recv_tick.sum(dtype=I32))
 
@@ -673,12 +756,17 @@ def make_folded_step(cfg):
                     # difft/present are folded planes; the shared
                     # builder reduces over every axis, and a fold is a
                     # reshape, so the counts are bit-equal to the
-                    # natural twin's.
+                    # natural twin's.  Under FUSED_PROBE the staleness/
+                    # suspicion counts come off the fused traversal.
+                    stale = susp = None
+                    if pfo is not None and "stale_rows" in pfo:
+                        stale = pfo["stale_rows"].sum(axis=0)
+                        susp = pfo["susp_rows"].sum(axis=0)
                     hist = build_tick_hist(
                         difft=difft, present=present, size=size,
                         act=act, t=t, fail_time=fail_time,
                         tfail=cfg.tfail, det_tick=det_tick,
-                        dropped=dropped_tick)
+                        dropped=dropped_tick, stale=stale, susp=susp)
                     return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out
@@ -939,14 +1027,28 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         # ---- probe issue (P-folded, shared) ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
+        pfo = None
         if p_cnt > 0:
-            ids_new, p_valid, probe_dropped = _fold_probe_window(
-                n, s, p_cnt, fp, window_idx, n_local, t, view, act,
-                local_node_p + row0, rng.probe_u if use_drop else None,
-                cfg.drop_prob, use_drop, drop_active,
-                count_dropped=cfg.telemetry,
-                scn_ctx=(None if scenario is None else
-                         (scenario, scn, cuts)))
+            if cfg.fused_probe:
+                (ids_new, p_valid, probe_dropped,
+                 pfo) = _fold_probe_window_fused(
+                    n, s, p_cnt, window_idx, cfg.tfail, cfg.fail_ids,
+                    cfg.telemetry and cfg.telemetry_hist, True, t,
+                    row0, view, view_ts, rep(act), rm_ids,
+                    local_node_p + row0,
+                    rng.probe_u if use_drop else None, cfg.drop_prob,
+                    use_drop, drop_active, count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts)))
+            else:
+                ids_new, p_valid, probe_dropped = _fold_probe_window(
+                    n, s, p_cnt, fp, window_idx, n_local, t, view, act,
+                    local_node_p + row0,
+                    rng.probe_u if use_drop else None,
+                    cfg.drop_prob, use_drop, drop_active,
+                    count_dropped=cfg.telemetry,
+                    scn_ctx=(None if scenario is None else
+                             (scenario, scn, cuts)))
             if cfg.telemetry and probe_dropped is not None:
                 telem_dropped.append(probe_dropped)
             probe_ids2, probe_ids1 = probe_ids1, ids_new
@@ -1037,16 +1139,18 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         else:
             failed = state.failed | (fail_mask_l & (t == fail_time))
 
+        pre = _fused_probe_pre(pfo, cfg.fail_ids, rowany)
         agg = update_fast_agg(
             state.agg, t=t, fail_ids=cfg.fail_ids,
             join_events=join_mask, rm_ids=rm_ids,
             view_ids=cur_id, view_present=present,
             fail_time=fail_time, holder_failed=fail_mask_l,
             sent_tick=sent_tick, recv_tick=recv_tick,
-            row_any=rowany, row_expand=rep)
+            row_any=rowany, row_expand=rep, pre=pre)
         out = SparseTickEvents(
             lax.psum(join_mask.sum(dtype=I32), AX),
-            lax.psum((rm_ids != EMPTY).sum(dtype=I32), AX),
+            lax.psum(pre["rm_total"] if pre is not None else
+                     (rm_ids != EMPTY).sum(dtype=I32), AX),
             lax.psum(sent_tick.sum(dtype=I32), AX),
             lax.psum(recv_tick.sum(dtype=I32), AX))
 
@@ -1080,12 +1184,19 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                     # Local partial histograms psum'd per field (the
                     # count reductions are linear); the log2 drop bucket
                     # is not, so it takes the GLOBAL dropped scalar.
+                    # Fused-probe stale/susp partials are local too —
+                    # the builder psums them with the rest.
+                    stale = susp = None
+                    if pfo is not None and "stale_rows" in pfo:
+                        stale = pfo["stale_rows"].sum(axis=0)
+                        susp = pfo["susp_rows"].sum(axis=0)
                     hist = build_tick_hist(
                         difft=difft, present=present, size=size,
                         act=act, t=t, fail_time=fail_time,
                         tfail=cfg.tfail, det_tick=det_local,
                         dropped=dropped_g,
-                        psum=lambda v: lax.psum(v, AX))
+                        psum=lambda v: lax.psum(v, AX),
+                        stale=stale, susp=susp)
                     return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out

@@ -737,16 +737,41 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         # ---- probe issue ----
         probe_ids1, probe_ids2 = state.probe_ids1, state.probe_ids2
         act_prev = state.act_prev
+        pfo = None
         if cfg.probes > 0:
             with jax.named_scope(PHASE_PROBE):
                 ptr = lax.rem(t * cfg.probes, s)
-                window = ptr_switch(
-                    ptr, cfg.probes, s,
-                    lambda o, v: jnp.roll(v, -o, axis=1)[:, :cfg.probes],
-                    view)
-                w_pres = window > 0
-                w_id = ((window - U32(1)) % U32(n)).astype(I32)
-                p_valid = w_pres & (w_id != lrows[:, None]) & act[:, None]
+                if cfg.fused_probe:
+                    # One Pallas traversal of the local post-receive
+                    # planes: pre-validated window ids + FastAgg/hist
+                    # row partials (ops/fused_probe; cuts and coins
+                    # apply below with the exact unfused streams).
+                    from distributed_membership_tpu.ops.fused_probe \
+                        import probe_window_fused
+                    want_hist = cfg.telemetry and cfg.telemetry_hist
+                    want_agg = cfg.fast_agg and not cfg.collect_events
+                    pfo = probe_window_fused(
+                        n, s, cfg.probes, cfg.tfail,
+                        cfg.fail_ids if want_agg else (),
+                        want_hist, want_agg,
+                        jax.default_backend() != "tpu",
+                        t, ptr, row0, view,
+                        view_ts if want_hist else None, act,
+                        rm_ids if want_agg else None)
+                    window_ids = pfo["ids"][:, :cfg.probes]
+                    p_valid = window_ids > 0
+                    w_id = jnp.where(p_valid,
+                                     window_ids.astype(I32) - 1, 0)
+                else:
+                    window = ptr_switch(
+                        ptr, cfg.probes, s,
+                        lambda o, v:
+                            jnp.roll(v, -o, axis=1)[:, :cfg.probes],
+                        view)
+                    w_pres = window > 0
+                    w_id = ((window - U32(1)) % U32(n)).astype(I32)
+                    p_valid = (w_pres & (w_id != lrows[:, None])
+                               & act[:, None])
                 if scenario is not None and scenario.n_parts:
                     # Cross-partition probes cut at issue time (as the
                     # drop coin), so counters and the ack pipeline see
@@ -859,12 +884,26 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             out = SparseTickEvents(join_ids, rm_ids, sent_tick, recv_tick)
         else:
             if cfg.fast_agg:
+                pre = None
+                if pfo is not None and "rm_cnt" in pfo:
+                    # Row partials off the fused probe traversal —
+                    # order-free integer sums/ors, bit-equal to the
+                    # plane passes they replace.
+                    pre = {"rm_total": pfo["rm_cnt"].sum(dtype=I32)}
+                    if cfg.fail_ids:
+                        det_cols = pfo["det_cols"]
+                        pre["det_tick"] = jnp.stack(
+                            [d.sum(dtype=I32) for d in det_cols])
+                        any_rm = det_cols[0][:, 0] > 0
+                        for d in det_cols[1:]:
+                            any_rm = any_rm | (d[:, 0] > 0)
+                        pre["any_true_rm"] = any_rm
                 agg = update_fast_agg(
                     state.agg, t=t, fail_ids=cfg.fail_ids,
                     join_events=join_mask, rm_ids=rm_ids,
                     view_ids=cur_id, view_present=present,
                     fail_time=fail_time, holder_failed=fail_mask_l,
-                    sent_tick=sent_tick, recv_tick=recv_tick)
+                    sent_tick=sent_tick, recv_tick=recv_tick, pre=pre)
             else:
                 agg = update_agg(
                     state.agg, t=t, join_ids=join_ids, rm_ids=rm_ids,
@@ -912,13 +951,19 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 if cfg.telemetry_hist:
                     # Local partial histograms psum'd per field (linear
                     # reductions); the log2 drop bucket takes the GLOBAL
-                    # dropped scalar (observability/timeline.py).
+                    # dropped scalar (observability/timeline.py).  The
+                    # fused-probe stale/susp partials are local too.
+                    stale = susp = None
+                    if pfo is not None and "stale_rows" in pfo:
+                        stale = pfo["stale_rows"].sum(axis=0)
+                        susp = pfo["susp_rows"].sum(axis=0)
                     hist = build_tick_hist(
                         difft=difft, present=present, size=size,
                         act=act, t=t, fail_time=fail_time,
                         tfail=cfg.tfail, det_tick=det_local,
                         dropped=dropped_g,
-                        psum=lambda v: lax.psum(v, AX))
+                        psum=lambda v: lax.psum(v, AX),
+                        stale=stale, susp=susp)
                     return new_state, (out, (telem, hist))
             return new_state, (out, telem)
         return new_state, out

@@ -113,10 +113,22 @@ class Params:
     FUSED_RECEIVE: int = -1
     # Deliver all circulant gossip shifts in one Pallas traversal
     # (ops/fused_gossip) instead of fanout separate roll+max passes.
-    # Requires EXCHANGE ring, VIEW_SIZE % 128 == 0, N a multiple of the
-    # view size ((N*STRIDE) % S == 0), and a drop-free config.
+    # Requires EXCHANGE ring, VIEW_SIZE % 128 == 0, and N a multiple of
+    # the view size ((N*STRIDE) % S == 0).  DROP_MSG, drop windows, and
+    # scenario link-flakes all compose: the per-shift keep masks are
+    # precomputed from the exact unfused RNG streams and ride the kernel
+    # as inputs (bit-exact trajectories either way).
     # 1/0/-1 as FUSED_RECEIVE (auto gated on banked chip evidence).
     FUSED_GOSSIP: int = -1
+    # Run the probe-window read plus the FastAgg removal reductions and
+    # the TELEMETRY hist staleness/suspicion bucket counts as ONE Pallas
+    # traversal of the post-receive planes (ops/fused_probe) instead of
+    # separate full-tensor passes.  Requires EXCHANGE ring and
+    # 0 < PROBES < VIEW_SIZE; drop coins and scenario cuts stay outside
+    # in the cheap [N, PROBES] window space with the exact unfused
+    # streams, so trajectories are bit-exact.
+    # 1/0/-1 as FUSED_RECEIVE (auto gated on banked chip evidence).
+    FUSED_PROBE: int = -1
     # Folded [N/F, 128] physical layout for VIEW_SIZE < 128 (F = 128/S):
     # removes the 128-lane padding that costs the S=16 regime ~8x HBM on
     # TPU (backends/tpu_hash_folded.py).  Requires EXCHANGE ring,
@@ -519,7 +531,8 @@ class Params:
         if self.FLEET_LINGER not in (0, 1):
             raise ValueError(
                 f"FLEET_LINGER must be 0 or 1, got {self.FLEET_LINGER!r}")
-        for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FOLDED"):
+        for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FUSED_PROBE",
+                     "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):
                 raise ValueError(
                     f"{knob} must be 1 (on), 0 (off) or -1 (auto), got "

@@ -213,7 +213,7 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
                     view_ids: jax.Array, view_present: jax.Array,
                     fail_time: jax.Array, holder_failed: jax.Array,
                     sent_tick: jax.Array, recv_tick: jax.Array,
-                    row_any=None, row_expand=None) -> FastAgg:
+                    row_any=None, row_expand=None, pre=None) -> FastAgg:
     """One tick, all elementwise/reduce (``fail_ids`` is a STATIC tuple).
 
     ``join_events``: [rows, M] bool (admissions this tick); ``rm_ids``:
@@ -223,6 +223,16 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
     per-observer [rows] vectors — default to ``any(axis=1)`` /
     ``v[:, None]`` for the natural [rows, M] layout; the folded layout
     passes its segment-aware pair (backends/tpu_hash_folded.py).
+
+    ``pre`` (optional dict) supplies PRECOMPUTED per-tick reductions of
+    the rm plane — keys ``det_tick`` ([F] i32, NOT yet gated by
+    ``t > fail_time``), ``any_true_rm`` ([rows] bool), and ``rm_total``
+    (scalar i32).  The FUSED_PROBE kernel emits these as row partials
+    riding its state traversal (ops/fused_probe), so the per-fail-id
+    compare passes over ``rm_ids`` are skipped here; integer sums and
+    or-reductions are order-free, so the results are bit-equal.  The
+    fail-tick tracker census still reads the view planes (cond-gated to
+    one tick).
     """
     rm_mask = rm_ids >= 0
     post = t > fail_time
@@ -235,12 +245,16 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
     n_obs = holder_failed.shape[0]
 
     if fail_ids:
-        per_f_rm = [rm_mask & (rm_ids == f) for f in fail_ids]
-        det_tick = jnp.stack(
-            [m.sum(dtype=I32) for m in per_f_rm]) * post.astype(I32)
-        any_true_rm = jnp.zeros((n_obs,), bool)
-        for m in per_f_rm:
-            any_true_rm = any_true_rm | row_any(m)
+        if pre is not None:
+            det_tick = pre["det_tick"] * post.astype(I32)
+            any_true_rm = pre["any_true_rm"]
+        else:
+            per_f_rm = [rm_mask & (rm_ids == f) for f in fail_ids]
+            det_tick = jnp.stack(
+                [m.sum(dtype=I32) for m in per_f_rm]) * post.astype(I32)
+            any_true_rm = jnp.zeros((n_obs,), bool)
+            for m in per_f_rm:
+                any_true_rm = any_true_rm | row_any(m)
 
         def census():
             live = ~row_expand(holder_failed)
@@ -266,7 +280,8 @@ def update_fast_agg(agg: FastAgg, *, t: jax.Array, fail_ids: tuple,
         det_obs=agg.det_obs | (any_true_rm & post),
         lat_hist=agg.lat_hist.at[lat].add(det_tick.sum()),
         join_total=agg.join_total + join_events.sum(dtype=I32),
-        rm_total=agg.rm_total + rm_mask.sum(dtype=I32),
+        rm_total=agg.rm_total + (rm_mask.sum(dtype=I32) if pre is None
+                                 else pre["rm_total"]),
         sent_total=agg.sent_total + sent_tick,
         recv_total=agg.recv_total + recv_tick,
     )

@@ -233,7 +233,7 @@ def drops_hist(dropped, nbins: int = HIST_BUCKETS["h_drops"]):
 
 
 def build_tick_hist(*, difft, present, size, act, t, fail_time, tfail,
-                    det_tick, dropped, psum=None):
+                    det_tick, dropped, psum=None, stale=None, susp=None):
     """The TickHist every ring twin emits, from tensors the step already
     holds: ``difft``/``present`` are the post-receive staleness planes
     ([N, S] natural or [N*S/128, 128] folded), ``size``/``act`` the
@@ -242,13 +242,23 @@ def build_tick_hist(*, difft, present, size, act, t, fail_time, tfail,
     twins pass the LOCAL tensors plus ``psum`` (the axis reducer) and
     the GLOBAL ``dropped`` scalar — the four count histograms are linear
     so per-shard partials psum exactly; the log2 drop bucket is not, so
-    it must be computed after the merge."""
-    stale = hist_bucket_counts(difft, present,
-                               HIST_BUCKETS["h_staleness"],
-                               STALENESS_BUCKET_TICKS)
-    susp = hist_bucket_counts(difft - tfail, present & (difft >= tfail),
-                              HIST_BUCKETS["h_suspicion"],
-                              STALENESS_BUCKET_TICKS)
+    it must be computed after the merge.
+
+    ``stale``/``susp`` (optional [8] int32) are PRECOMPUTED staleness/
+    suspicion bucket counts — the FUSED_PROBE kernel emits them as
+    integer partials riding its [N, S] traversal (ops/fused_probe), and
+    integer bucket sums are order-free, so the counts are bit-equal to
+    :func:`hist_bucket_counts` over the same planes.  When given, the
+    corresponding plane passes here are skipped."""
+    if stale is None:
+        stale = hist_bucket_counts(difft, present,
+                                   HIST_BUCKETS["h_staleness"],
+                                   STALENESS_BUCKET_TICKS)
+    if susp is None:
+        susp = hist_bucket_counts(difft - tfail,
+                                  present & (difft >= tfail),
+                                  HIST_BUCKETS["h_suspicion"],
+                                  STALENESS_BUCKET_TICKS)
     occ = hist_bucket_counts(size, act, HIST_BUCKETS["h_occupancy"], 1)
     lat = scalar_one_hot(t - fail_time, LATENCY_BUCKETS, det_tick)
     if psum is not None:

@@ -196,7 +196,8 @@ def receive_folded_fused(n: int, s: int, tfail: int, tremove: int,
 def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
                           interpret: bool, mail: jax.Array,
                           payloads: jax.Array, thr: jax.Array,
-                          c1: jax.Array, c2: jax.Array) -> jax.Array:
+                          c1: jax.Array, c2: jax.Array,
+                          masks: jax.Array | None = None) -> jax.Array:
     """Accumulate K pre-masked folded payloads into the folded mailbox.
 
     Per shift j the jnp folded path computes
@@ -223,6 +224,13 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
       c1, c2:   [K] i32 slot-roll amounts (tpu_hash_folded.roll_slots)
                 for unwrapped/wrapped receiver rows; ``c2`` ignored when
                 ``single_col``.
+      masks:    optional [K, rows, 128] i32 per-shift keep masks
+                (nonzero = deliver), sender-indexed in the folded
+                layout.  When given, the kernel zeroes non-kept sender
+                entries in VMEM and ``payloads`` may be a SHARED
+                [1, rows, 128] stack (the unmasked folded view broadcast
+                to every shift) — the single-chip lossy/scenario branch
+                uses this to skip materializing K payload copies.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -246,13 +254,20 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
         return jnp.where(pos < c, pltpu.roll(x, c + LANES - s, axis=1),
                          pltpu.roll(x, c, axis=1))
 
+    shared_payload = payloads.shape[0] == 1
+
     def kernel(thr_ref, rq_ref, rr_ref, c1_ref, c2_ref,
-               mail_ref, plo_ref, phi_ref, out_ref):
+               mail_ref, plo_ref, phi_ref, *rest):
+        out_ref = rest[-1]
         i, j = pl.program_id(0), pl.program_id(1)
         rq_j, rr_j = rq_ref[j], rr_ref[j]
         start = jax.lax.rem(i * b - rq_j - 1 + rows, rows)
         off = jax.lax.rem(start, b)
         rows2b = jnp.concatenate([plo_ref[0], phi_ref[0]], axis=0)
+        if masks is not None:
+            mlo_ref, mhi_ref = rest[0], rest[1]
+            keep2b = jnp.concatenate([mlo_ref[0], mhi_ref[0]], axis=0)
+            rows2b = jnp.where(keep2b != 0, rows2b, U32(0))
         # The b+1 sender rows starting at ``off``: Mosaic TC has no
         # dynamic_slice lowering, so rotate row ``off`` to row 0 (dynamic
         # sublane roll) and take static slices — as in
@@ -279,18 +294,33 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
 
         out_ref[:] = umax(out_ref[:], delivered)
 
+    def _payload_j(i, j, *sc):
+        return 0 if shared_payload else j
+
+    in_specs = [
+        pl.BlockSpec((b, LANES),
+                     lambda i, j, *sc: (i, 0)),                 # mail
+        pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                     (_payload_j(i, j, *sc),
+                      _lo_block(i, j, *sc), 0)),                # payload lo
+        pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                     (_payload_j(i, j, *sc), jax.lax.rem(
+                         _lo_block(i, j, *sc) + 1, nb), 0)),    # payload hi
+    ]
+    operands = [mail, payloads, payloads]
+    if masks is not None:
+        in_specs += [
+            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                         (j, _lo_block(i, j, *sc), 0)),         # mask lo
+            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
+                         (j, jax.lax.rem(
+                             _lo_block(i, j, *sc) + 1, nb), 0)),
+        ]
+        operands += [masks, masks]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(nb, k_max),
-        in_specs=[
-            pl.BlockSpec((b, LANES),
-                         lambda i, j, *sc: (i, 0)),                 # mail
-            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
-                         (j, _lo_block(i, j, *sc), 0)),             # payload lo
-            pl.BlockSpec((1, b, LANES), lambda i, j, *sc:
-                         (j, jax.lax.rem(
-                             _lo_block(i, j, *sc) + 1, nb), 0)),    # payload hi
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, LANES), lambda i, j, *sc: (i, 0)),
     )
     return pl.pallas_call(
@@ -299,4 +329,4 @@ def gossip_folded_stacked(rows: int, s: int, k_max: int, single_col: bool,
         out_shape=jax.ShapeDtypeStruct((rows, LANES), U32),
         interpret=interpret,
     )(thr.astype(I32), rq, rr, c1.astype(I32),
-      c2.astype(I32), mail, payloads, payloads)
+      c2.astype(I32), *operands)

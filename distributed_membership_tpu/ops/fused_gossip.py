@@ -21,13 +21,18 @@ Supported when (enforced by :func:`gossip_fused_supported`):
 * ``S % 128 == 0`` — whole-lane rows (same tiling rule as fused_receive);
 * ``(N * STRIDE) % S == 0`` — the wrapped/unwrapped receiver rows share
   one column shift, matching the jnp path's single-roll fast case
-  (tpu_hash.py make_step: "they coincide iff N*STRIDE % S == 0");
-* no message drops FOR THIS KERNEL — the jnp path draws a fresh [N, S]
-  Bernoulli mask per shift; replicating that stream in-kernel would fork
-  the RNG semantics.  Lossy configs still fuse: the step pre-masks each
-  shift's payload outside with the exact jnp-path draws and routes
-  through :func:`gossip_fused_stacked` instead (tpu_hash.py make_step),
-  trading the single VMEM-resident payload for a [K, N, S] stack.
+  (tpu_hash.py make_step: "they coincide iff N*STRIDE % S == 0").
+
+Message drops, scenario link-flakes, and drop windows all compose: the
+per-shift keep decisions are never drawn in-kernel (replicating the RNG
+stream inside Mosaic would fork the semantics) — the step computes them
+OUTSIDE from the ops/rng_plan.py batched coin streams, exactly as the
+jnp shift loop does, and hands them to the kernel as a stacked
+``masks [K, N, S]`` input.  The kernel fetches mask blocks with the same
+scalar-prefetch index maps as the payload blocks and zeroes non-kept
+sender entries in VMEM, so the payload itself stays a SINGLE unmasked
+[N, S] tensor (no per-shift [K, N, S] payload copies) and the delivered
+bits are bit-identical to the unfused path by construction.
 
 Semantics are pinned bit-exactly against the jnp shift loop in interpret
 mode (tests/test_fused_gossip.py) and end-to-end via the FUSED_GOSSIP
@@ -86,7 +91,8 @@ def _assemble_senders(plo, phi, off, b: int):
 def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
                          interpret: bool, mail: jax.Array,
                          payloads: jax.Array, c_shifts: jax.Array,
-                         s1s: jax.Array, s2s: jax.Array) -> jax.Array:
+                         s1s: jax.Array, s2s: jax.Array,
+                         masks: jax.Array | None = None) -> jax.Array:
     """Sharded-ring variant: accumulate K PRE-ROUTED payloads into mail.
 
     The torus exchange (tpu_hash_sharded.make_ring_sharded_step) routes
@@ -95,30 +101,45 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
     for the intra-shard row roll + column alignment + max.  This kernel
     replaces that local tail: the grid walks (mail block, shift) with the
     mail block VMEM-resident, sender rows arrive via scalar-prefetch
-    block indexing from the stacked ``payloads [K, L, S]`` (already
-    sender-masked — including per-shift drop masks, which both the
-    sharded ring and the single-chip lossy branch bake into the stack
-    before the call), and the
+    block indexing from the stacked ``payloads [K, L, S]``, and the
     column alignment applies ``s1s[j]`` — or the
     ``s2s[j]``/receiver-row select pair when ``single_col`` is False
     (the (L*STRIDE) % S != 0 wrapped-row case).  ~(2K + 2) local passes
     instead of ~3K.
+
+    Drop/flake handling: either pre-mask the stack (the sharded ring
+    must — the keep coins are sender-row-indexed, so they have to be
+    applied BEFORE the payload rides the ppermute wire) and leave
+    ``masks`` None, or pass ``masks [K, L, S]`` i32 (nonzero = deliver)
+    and the kernel zeroes non-kept entries in VMEM after assembling the
+    sender rows.  With ``masks`` the payload stack may be SHARED across
+    shifts: ``payloads [1, L, S]`` is broadcast to every j, which is how
+    the single-chip lossy branch avoids materializing K payload copies.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b = _pick_block(rows)
     nb = rows // b
+    shared_payload = payloads.shape[0] == 1
 
     def _lo_block(i, j, c, s1v, s2v):
         return _lo_block_idx(i, b, rows, c[j])
 
+    def _payload_j(i, j, c, s1v, s2v):
+        return 0 if shared_payload else j
+
     def kernel(c_ref, s1_ref, s2_ref, mail_ref, plo_ref, phi_ref,
-               out_ref):
+               *rest):
+        out_ref = rest[-1]
         i, j = pl.program_id(0), pl.program_id(1)
         c = c_ref[j]
         off = jax.lax.rem(jax.lax.rem(i * b - c + rows, rows), b)
         senders = _assemble_senders(plo_ref[0], phi_ref[0], off, b)
+        if masks is not None:
+            mlo_ref, mhi_ref = rest[0], rest[1]
+            keep = _assemble_senders(mlo_ref[0], mhi_ref[0], off, b)
+            senders = jnp.where(keep != 0, senders, U32(0))
         r1 = pltpu.roll(senders, s1_ref[j], axis=1)
         if single_col:
             delivered = r1
@@ -133,17 +154,29 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
 
         out_ref[:] = umax(out_ref[:], delivered)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(nb, k_max),
-        in_specs=[
-            pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
+    in_specs = [
+        pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
+        pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
+                     (_payload_j(i, j, c, s1v, s2v),
+                      _lo_block(i, j, c, s1v, s2v), 0)),
+        pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
+                     (_payload_j(i, j, c, s1v, s2v), jax.lax.rem(
+                         _lo_block(i, j, c, s1v, s2v) + 1, nb), 0)),
+    ]
+    operands = [mail, payloads, payloads]
+    if masks is not None:
+        in_specs += [
             pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
                          (j, _lo_block(i, j, c, s1v, s2v), 0)),
             pl.BlockSpec((1, b, s), lambda i, j, c, s1v, s2v:
                          (j, jax.lax.rem(
                              _lo_block(i, j, c, s1v, s2v) + 1, nb), 0)),
-        ],
+        ]
+        operands += [masks, masks]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nb, k_max),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, s), lambda i, j, c, s1v, s2v: (i, 0)),
     )
     from distributed_membership_tpu.observability.timeline import (
@@ -155,13 +188,14 @@ def gossip_fused_stacked(rows: int, s: int, k_max: int, single_col: bool,
             out_shape=jax.ShapeDtypeStruct((rows, s), U32),
             interpret=interpret,
         )(c_shifts.astype(I32), s1s.astype(I32), s2s.astype(I32),
-          mail, payloads, payloads)
+          *operands)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
                  mail: jax.Array, payload: jax.Array,
-                 k_eff: jax.Array, shifts: jax.Array) -> jax.Array:
+                 k_eff: jax.Array, shifts: jax.Array,
+                 masks: jax.Array | None = None) -> jax.Array:
     """``max(mail, max_j roll2d(where(j < k_eff, payload, 0), shifts[j]))``.
 
     Args:
@@ -171,6 +205,12 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
       k_eff:   [N] i32 per-sender effective fanout (shift j delivers rows
                with ``j < k_eff``).
       shifts:  [k_max] i32 circulant row shifts, values in [1, N).
+      masks:   optional [k_max, N, S] i32 per-shift keep masks (nonzero =
+               deliver), sender-indexed.  When given they SUBSUME the
+               ``k_eff`` fanout gate (the caller folds ``j < k_eff`` in
+               along with drop coins / scenario flakes / drop windows),
+               so the k_eff planes are not fetched — lossy and scenario
+               configs ride this kernel with a single unmasked payload.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -183,16 +223,22 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
     def _lo_block(i, j, sh):
         return _lo_block_idx(i, b, rows, sh[j])
 
-    def kernel(sh_ref, mail_ref, plo_ref, phi_ref, klo_ref, khi_ref,
-               out_ref):
+    def kernel(sh_ref, mail_ref, plo_ref, phi_ref, *rest):
+        out_ref = rest[-1]
         i, j = pl.program_id(0), pl.program_id(1)
         r = sh_ref[j]
         off = jax.lax.rem(jax.lax.rem(i * b - r + rows, rows), b)
         senders = _assemble_senders(plo_ref[:], phi_ref[:], off, b)
-        # k_eff rides as [rows, 1] planes (1-D refs can't take the
-        # sublane rotate _assemble_senders needs on the real chip).
-        ke = _assemble_senders(klo_ref[:], khi_ref[:], off, b)
-        senders = jnp.where(j < ke, senders, U32(0))
+        if masks is None:
+            # k_eff rides as [rows, 1] planes (1-D refs can't take the
+            # sublane rotate _assemble_senders needs on the real chip).
+            klo_ref, khi_ref = rest[0], rest[1]
+            ke = _assemble_senders(klo_ref[:], khi_ref[:], off, b)
+            senders = jnp.where(j < ke, senders, U32(0))
+        else:
+            mlo_ref, mhi_ref = rest[0], rest[1]
+            keep = _assemble_senders(mlo_ref[0], mhi_ref[0], off, b)
+            senders = jnp.where(keep != 0, senders, U32(0))
 
         # Column alignment: one shift for all rows (the supported case
         # (N*STRIDE) % S == 0 — see module docstring).
@@ -206,20 +252,33 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
         out_ref[:] = umax(out_ref[:], delivered)
 
     row_block = lambda i, j, sh: (i, 0)           # noqa: E731
+    in_specs = [
+        pl.BlockSpec((b, s), row_block),                       # mail
+        pl.BlockSpec((b, s), lambda i, j, sh:
+                     (_lo_block(i, j, sh), 0)),                # payload lo
+        pl.BlockSpec((b, s), lambda i, j, sh:
+                     (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
+    ]
+    if masks is None:
+        in_specs += [
+            pl.BlockSpec((b, 1), lambda i, j, sh:
+                         (_lo_block(i, j, sh), 0)),            # k_eff lo
+            pl.BlockSpec((b, 1), lambda i, j, sh:
+                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
+        ]
+        extra = (k_eff.astype(I32)[:, None], k_eff.astype(I32)[:, None])
+    else:
+        in_specs += [
+            pl.BlockSpec((1, b, s), lambda i, j, sh:
+                         (j, _lo_block(i, j, sh), 0)),         # mask lo
+            pl.BlockSpec((1, b, s), lambda i, j, sh:
+                         (j, jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
+        ]
+        extra = (masks, masks)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nb, k_max),
-        in_specs=[
-            pl.BlockSpec((b, s), row_block),                       # mail
-            pl.BlockSpec((b, s), lambda i, j, sh:
-                         (_lo_block(i, j, sh), 0)),                # payload lo
-            pl.BlockSpec((b, s), lambda i, j, sh:
-                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
-            pl.BlockSpec((b, 1), lambda i, j, sh:
-                         (_lo_block(i, j, sh), 0)),                # k_eff lo
-            pl.BlockSpec((b, 1), lambda i, j, sh:
-                         (jax.lax.rem(_lo_block(i, j, sh) + 1, nb), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, s), row_block),
     )
     from distributed_membership_tpu.observability.timeline import (
@@ -230,5 +289,4 @@ def gossip_fused(n: int, s: int, k_max: int, interpret: bool,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((rows, s), U32),
             interpret=interpret,
-        )(shifts.astype(I32), mail, payload, payload,
-          k_eff.astype(I32)[:, None], k_eff.astype(I32)[:, None])
+        )(shifts.astype(I32), mail, payload, payload, *extra)

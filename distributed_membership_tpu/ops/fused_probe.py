@@ -1,0 +1,374 @@
+"""Fused probe-window traversal: window update + aggregate/telemetry
+reductions in ONE pass over the ``[N, S]`` state.
+
+After receive and gossip were fused (ops/fused_receive, ops/fused_gossip)
+the remaining per-tick full-tensor passes in the ring step are the probe
+stage and the reductions that read the same planes right after it
+(backends/tpu_hash.py make_step):
+
+* the probe-window read — a P-column cyclic band of the post-receive
+  view, rolled to the tick's pointer, validated (occupied, not self,
+  observer act) and recorded as ``probe_ids1`` for the two-tick ack
+  pipeline (whose packed-u32 single-gather application already rides the
+  fused RECEIVE kernel via the ack candidate plane);
+* the FastAgg per-fail-id compare passes over the removal plane
+  (observability/aggregates.update_fast_agg);
+* the telemetry staleness/suspicion bucket counts over the post-receive
+  ``view_ts`` (observability/timeline.build_tick_hist) when
+  ``TELEMETRY: hist`` is on.
+
+These all traverse the same [N, S] (or folded [N*S/128, 128]) planes, so
+the kernels here run them as one grid walk: per row block the view is
+read once, the rolled window ids come out as a plane, and the agg/hist
+reductions ride as [rows, 1]/[rows, 8] column partials plus (folded) one
+any-plane.  Integer sums and or-reductions are order-free, so every
+partial reduces outside to values bit-equal to the unfused lowering.
+
+What stays OUTSIDE the kernel — by design, for bit-exactness:
+
+* drop coins / scenario cuts (``PROBE`` leg): suppression happens in the
+  cheap [N, P] window space with the exact ops/rng_plan.py streams the
+  jnp path draws — the kernel only pre-validates (occupied, not self,
+  act), and every suppressed position is consulted nowhere else;
+* the packed probe-table gather (ops._pack_probe_table consumers): an
+  [N]-class gather Mosaic TC cannot express — it remains the step's ONE
+  permitted big gather (tests/test_hlo_census.py pins that);
+* the folded window compaction gather (``window_idx``): pre-existing,
+  and it now gathers the kernel's VALIDATED id plane instead of the raw
+  window — same gather count, one fewer plane pass.
+
+Routing: all four ring twins (tpu_hash natural/FOLDED and their sharded
+twins) call these kernels behind the ``FUSED_PROBE: -1|0|1`` conf knob;
+auto resolution rides the fusegate correctness families ``fused_probe``
+/ ``folded_fused_probe_s{S}`` (+ ``sharded_`` prefixes) like the other
+kernels (runtime/fusegate.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.ops.fused_receive import _pick_block
+
+I32 = jnp.int32
+U32 = jnp.uint32
+LANES = 128
+
+# h_staleness / h_suspicion geometry (observability/timeline.py).  The
+# bucket width is a power of two so the in-kernel bucket index is a shift
+# (Mosaic-safe); the import is asserted at module load so a width change
+# cannot silently fork the counts.
+from distributed_membership_tpu.observability.timeline import (  # noqa: E402
+    HIST_BUCKETS, STALENESS_BUCKET_TICKS)
+
+_NB = HIST_BUCKETS["h_staleness"]
+assert STALENESS_BUCKET_TICKS & (STALENESS_BUCKET_TICKS - 1) == 0
+_BUCKET_SHIFT = STALENESS_BUCKET_TICKS.bit_length() - 1
+
+
+def probe_fused_supported(n: int, s: int, p_cnt: int) -> bool:
+    """Natural-layout eligibility: whole-lane rows (same tiling rule as
+    the other kernels) and a window narrower than the view."""
+    return s % 128 == 0 and n >= 8 and 0 < p_cnt < s
+
+
+def _bucket_rows(vals, mask):
+    """[b, 8] per-row staleness bucket counts: lane-axis reductions only
+    (sublane reductions are the less-robust Mosaic path).  ``vals`` are
+    non-negative tick deltas; bucket = clip(vals >> shift, 0, 7), the
+    same index :func:`timeline.hist_bucket_counts` computes with ``//``
+    (clip spelled as compare+select — arith.maxsi/minsi are not relied
+    on, mirroring the umax story in ops/fused_receive)."""
+    b = jax.lax.shift_right_arithmetic(vals, _BUCKET_SHIFT)
+    b = jnp.where(b > _NB - 1, _NB - 1, b)
+    b = jnp.where(b < 0, 0, b)
+    cols = [((b == k) & mask).astype(I32).sum(axis=1, dtype=I32,
+                                              keepdims=True)
+            for k in range(_NB)]
+    return jnp.concatenate(cols, axis=1)
+
+
+def _probe_body(n, tfail, fail_ids, want_hist, want_agg,
+                t, rolled, node, actb, ts, rm):
+    """Shared per-block computation (jnp ops only) for both layouts.
+
+    ``rolled`` is the view block already rolled so that lane (segment
+    position, folded) 0 holds the window pointer's slot; ``node`` the
+    per-element observer id; ``actb`` the observer-act bool.  Returns
+    (ids, stale_rows, susp_rows, det_cols, det_any, rm_cnt) with the
+    optional pieces None when the corresponding want_* is off.
+    """
+    pres = rolled > 0
+    w_id = ((rolled - U32(1)) % U32(n)).astype(I32)
+    valid = pres & (w_id != node) & actb
+    ids = jnp.where(valid, w_id.astype(U32) + U32(1), U32(0))
+
+    stale_rows = susp_rows = None
+    if want_hist:
+        difft = t - ts
+        # presence must match the UNROLLED view — but a roll is a
+        # permutation of each row/segment and the bucket counts only see
+        # the element multiset, so counting on the rolled plane with the
+        # equally-rolled ts is bit-equal.  ``ts`` arrives pre-rolled.
+        presv = rolled > 0
+        stale_rows = _bucket_rows(difft, presv)
+        susp_rows = _bucket_rows(difft - tfail,
+                                 presv & (difft >= tfail))
+
+    det_cols = det_any = rm_cnt = None
+    if want_agg:
+        rm_cnt = (rm >= 0).astype(I32).sum(axis=1, dtype=I32,
+                                           keepdims=True)
+        det_cols = [(rm == f).astype(I32).sum(axis=1, dtype=I32,
+                                              keepdims=True)
+                    for f in fail_ids]
+        if fail_ids:
+            da = rm == fail_ids[0]
+            for f in fail_ids[1:]:
+                da = da | (rm == f)
+            det_any = da.astype(I32)
+    return ids, stale_rows, susp_rows, det_cols, det_any, rm_cnt
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def probe_window_fused(n: int, s: int, p_cnt: int, tfail: int,
+                       fail_ids: tuple, want_hist: bool, want_agg: bool,
+                       interpret: bool, t, ptr, row0,
+                       view: jax.Array, view_ts, act, rm_ids):
+    """Natural-layout fused probe traversal.
+
+    Args:
+      t, ptr, row0: traced scalars — tick, window pointer
+        (``(t*P) mod S``), and the first row's GLOBAL id (0 single-chip;
+        the shard row offset on the sharded twin).
+      view:    [rows, S] u32 post-receive view.
+      view_ts: [rows, S] i32 post-receive timestamps (None unless
+               ``want_hist``).
+      act:     [rows] bool observer liveness.
+      rm_ids:  [rows, S] i32 removal plane from the receive pass (None
+               unless ``want_agg``).
+
+    Returns a dict: ``ids`` [rows, ceil128(P)] u32 pre-suppression probe
+    ids (slice ``[:, :P]``; 0 = invalid — drop coins / scenario cuts
+    apply OUTSIDE in [N, P] space), plus ``stale_rows``/``susp_rows``
+    ([rows, 8] i32 per-row bucket partials) when ``want_hist`` and
+    ``det_cols`` (tuple of [rows, 1] per fail id), ``rm_cnt`` ([rows, 1])
+    when ``want_agg``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = view.shape[0]
+    b = _pick_block(rows)
+    grid = (rows // b,)
+    wp = ((p_cnt + LANES - 1) // LANES) * LANES
+    n_fail = len(fail_ids) if want_agg else 0
+
+    def kernel(sc_ref, view_ref, *rest):
+        rest = list(rest)
+        ts_ref = rest.pop(0) if want_hist else None
+        act_ref = rest.pop(0)
+        rm_ref = rest.pop(0) if want_agg else None
+        outs = rest
+        i = pl.program_id(0)
+        t_k, ptr_k, row0_k = sc_ref[0], sc_ref[1], sc_ref[2]
+        c = jax.lax.rem(s - ptr_k, s)
+        rolled = pltpu.roll(view_ref[:], c, axis=1)
+        node = (row0_k + i * b
+                + jax.lax.broadcasted_iota(I32, (b, s), 0))
+        actb = act_ref[:] != 0
+        ts = (pltpu.roll(ts_ref[:], c, axis=1) if want_hist else None)
+        rm = rm_ref[:] if want_agg else None
+        ids, stale_r, susp_r, det_cols, _, rm_cnt = _probe_body(
+            n, tfail, fail_ids, want_hist, want_agg,
+            t_k, rolled, node, actb, ts, rm)
+        k = 0
+        outs[k][:] = ids[:, :wp]
+        k += 1
+        if want_hist:
+            outs[k][:] = stale_r
+            outs[k + 1][:] = susp_r
+            k += 2
+        if want_agg:
+            outs[k][:] = rm_cnt
+            k += 1
+            for d in det_cols:
+                outs[k][:] = d
+                k += 1
+
+    row_spec = pl.BlockSpec((b, s), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((b, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    hist_spec = pl.BlockSpec((b, _NB), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), row_spec]
+    operands = [jnp.stack([jnp.asarray(t, I32), jnp.asarray(ptr, I32),
+                           jnp.asarray(row0, I32)]), view]
+    if want_hist:
+        in_specs.append(row_spec)
+        operands.append(view_ts)
+    in_specs.append(col_spec)
+    operands.append(act.astype(I32)[:, None])
+    if want_agg:
+        in_specs.append(row_spec)
+        operands.append(rm_ids)
+
+    out_specs = [pl.BlockSpec((b, wp), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((rows, wp), U32)]
+    if want_hist:
+        out_specs += [hist_spec, hist_spec]
+        out_shape += [jax.ShapeDtypeStruct((rows, _NB), I32)] * 2
+    if want_agg:
+        out_specs += [col_spec] * (1 + n_fail)
+        out_shape += [jax.ShapeDtypeStruct((rows, 1), I32)] * (1 + n_fail)
+
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_PROBE)
+    with jax.named_scope(PHASE_PROBE):
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*operands)
+    out = list(out)
+    res = {"ids": out.pop(0)}
+    if want_hist:
+        res["stale_rows"] = out.pop(0)
+        res["susp_rows"] = out.pop(0)
+    if want_agg:
+        res["rm_cnt"] = out.pop(0)
+        res["det_cols"] = tuple(out[:n_fail])
+    return res
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def probe_folded_window_fused(n: int, s: int, p_cnt: int, tfail: int,
+                              fail_ids: tuple, want_hist: bool,
+                              want_agg: bool, interpret: bool,
+                              t, ptr, row0,
+                              view: jax.Array, view_ts, actp, rm_ids):
+    """Folded-layout fused probe traversal ([rows, 128] planes, F = 128/S
+    nodes per row — backends/tpu_hash_folded.py layout contract).
+
+    Same contract as :func:`probe_window_fused` with two layout
+    differences: the window roll is SEGMENT-wise (roll_slots — spelled
+    as the two-roll position select, as in ops/fused_folded), and the
+    validated ``ids`` come back as a full S-folded [rows, 128] plane —
+    the caller compacts the window positions with its pre-existing
+    ``window_idx`` gather (same gather count as the unfused path).  When
+    ``want_agg``, an extra ``det_any`` [rows, 128] i32 plane marks
+    per-ELEMENT fail-id removals (per-node any needs the segment-aware
+    rowany reduction the backend owns).  ``actp``/``rm_ids`` are folded
+    planes; ``row0`` is the shard's first global NODE id.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = view.shape[0]
+    f = LANES // s
+    b = _pick_block(rows)
+    grid = (rows // b,)
+    n_fail = len(fail_ids) if want_agg else 0
+
+    def _seg_roll(x, c):
+        lane = jax.lax.broadcasted_iota(I32, x.shape, 1)
+        pos = jax.lax.rem(lane, s)
+        return jnp.where(pos < c, pltpu.roll(x, c + LANES - s, axis=1),
+                         pltpu.roll(x, c, axis=1))
+
+    def kernel(sc_ref, view_ref, *rest):
+        rest = list(rest)
+        ts_ref = rest.pop(0) if want_hist else None
+        actp_ref = rest.pop(0)
+        rm_ref = rest.pop(0) if want_agg else None
+        outs = rest
+        i = pl.program_id(0)
+        t_k, ptr_k, row0_k = sc_ref[0], sc_ref[1], sc_ref[2]
+        c = jax.lax.rem(s - ptr_k, s)
+        rolled = _seg_roll(view_ref[:], c)
+        lane = jax.lax.broadcasted_iota(I32, (b, LANES), 1)
+        prow = jax.lax.broadcasted_iota(I32, (b, LANES), 0)
+        node = row0_k + (i * b + prow) * f + lane // s
+        actb = actp_ref[:] != 0
+        ts = _seg_roll(ts_ref[:], c) if want_hist else None
+        rm = rm_ref[:] if want_agg else None
+        ids, stale_r, susp_r, det_cols, det_any, rm_cnt = _probe_body(
+            n, tfail, fail_ids, want_hist, want_agg,
+            t_k, rolled, node, actb, ts, rm)
+        k = 0
+        outs[k][:] = ids
+        k += 1
+        if want_hist:
+            outs[k][:] = stale_r
+            outs[k + 1][:] = susp_r
+            k += 2
+        if want_agg:
+            outs[k][:] = rm_cnt
+            k += 1
+            for d in det_cols:
+                outs[k][:] = d
+                k += 1
+            if n_fail:
+                outs[k][:] = det_any
+
+    row_spec = pl.BlockSpec((b, LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((b, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    hist_spec = pl.BlockSpec((b, _NB), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), row_spec]
+    operands = [jnp.stack([jnp.asarray(t, I32), jnp.asarray(ptr, I32),
+                           jnp.asarray(row0, I32)]), view]
+    if want_hist:
+        in_specs.append(row_spec)
+        operands.append(view_ts)
+    in_specs.append(row_spec)
+    operands.append(actp.astype(I32))
+    if want_agg:
+        in_specs.append(row_spec)
+        operands.append(rm_ids)
+
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((rows, LANES), U32)]
+    if want_hist:
+        out_specs += [hist_spec, hist_spec]
+        out_shape += [jax.ShapeDtypeStruct((rows, _NB), I32)] * 2
+    if want_agg:
+        out_specs += [col_spec] * (1 + n_fail)
+        out_shape += [jax.ShapeDtypeStruct((rows, 1), I32)] * (1 + n_fail)
+        if n_fail:
+            out_specs.append(row_spec)
+            out_shape.append(jax.ShapeDtypeStruct((rows, LANES), I32))
+
+    from distributed_membership_tpu.observability.timeline import (
+        PHASE_PROBE)
+    with jax.named_scope(PHASE_PROBE):
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*operands)
+    out = list(out)
+    res = {"ids": out.pop(0)}
+    if want_hist:
+        res["stale_rows"] = out.pop(0)
+        res["susp_rows"] = out.pop(0)
+    if want_agg:
+        res["rm_cnt"] = out.pop(0)
+        res["det_cols"] = tuple(out[:n_fail])
+        out = out[n_fail:]
+        if n_fail:
+            res["det_any"] = out.pop(0)
+    return res

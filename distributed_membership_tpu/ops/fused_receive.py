@@ -73,14 +73,23 @@ def _admit(n: int, self_mask, row_ids, view, incoming):
 
 def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
                   t, view, view_ts, mail, cand, rcol, actc,
-                  sonc, spackc, rowc):
+                  sonc, spackc, rowc, admitc=None):
     """The shared computation (jnp ops only — legal in both contexts).
 
     The per-node vectors arrive as COLUMN vectors ([rows, 1]): every use
     broadcasts against the [rows, S] planes anyway, and all-2-D shapes
-    keep the Pallas twin free of 1-D refs/values, which Mosaic's TC
+    keep the Pallas twin free of 1-D refs/values, which Mosaic TC's
     lowering handles far less robustly than lane-tiled 2-D (the same
     reason fused_gossip's k_eff sidecar rides [rows, 1] planes).
+
+    ``admitc`` (optional [rows, S] bool) is a precomputed receive-side
+    drop/flake mask: entries with ``admitc`` False behave as if the mail
+    was never delivered this tick — they neither admit nor refresh
+    (``incoming > 0`` gates them out of :func:`_admit` after zeroing).
+    The mailbox clear is computed from the ORIGINAL mail, so suppressed
+    entries still clear where ``rcol`` says the row received.  ``None``
+    (the default) leaves the program byte-identical to before the mask
+    existed — census pins depend on that.
 
     Returns (view, view_ts, mail_cleared, join_mask, rm_ids,
     numfailed, size) — the last two as [rows, 1] columns.
@@ -94,7 +103,8 @@ def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
 
     prev_present = view > 0
     # --- admit gossip mail (sticky admission) ---
-    admitted = _admit(n, self_mask, rowc, view, mail)
+    mail_in = mail if admitc is None else jnp.where(admitc, mail, U32(0))
+    admitted = _admit(n, self_mask, rowc, view, mail_in)
     new_view = jnp.where(rcol, admitted, view)
     changed = new_view > view
     new_ts = jnp.where(changed, t, view_ts)
@@ -132,10 +142,12 @@ def _receive_body(n: int, s: int, tfail: int, tremove: int, stride: int,
 
 def receive_core(n: int, s: int, tfail: int, tremove: int, stride: int,
                  t, view, view_ts, mail, cand, recv_mask, act,
-                 self_on, self_pack, row_ids):
+                 self_on, self_pack, row_ids, admit_mask=None):
     """Pure-jnp receive pass (reference AND default implementation).
     Takes the per-node vectors [N]-shaped; the column lifting/squeezing
-    happens here so callers are unchanged."""
+    happens here so callers are unchanged.  ``admit_mask`` (optional
+    [N, S] bool) suppresses admission of this tick's delivered entries
+    (see :func:`_receive_body`)."""
     from distributed_membership_tpu.observability.timeline import (
         PHASE_RECEIVE)
     with jax.named_scope(PHASE_RECEIVE):
@@ -143,7 +155,7 @@ def receive_core(n: int, s: int, tfail: int, tremove: int, stride: int,
             _receive_body(n, s, tfail, tremove, stride, t, view, view_ts,
                           mail, cand, recv_mask[:, None], act[:, None],
                           self_on[:, None], self_pack[:, None],
-                          row_ids[:, None])
+                          row_ids[:, None], admit_mask)
     return (new_view, new_ts, mail_cleared, join_mask, rm_ids,
             nf[:, 0], sz[:, 0])
 
@@ -164,12 +176,14 @@ def fused_supported(n: int, s: int) -> bool:
 def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
                   interpret: bool,
                   t, view, view_ts, mail, cand, recv_mask, act,
-                  self_on, self_pack, row_ids):
+                  self_on, self_pack, row_ids, admit_mask=None):
     """One-traversal Pallas version of :func:`receive_core`.
 
     Masks travel as int32 (bool VMEM tiling is dtype-hostile); the kernel
     body is :func:`_receive_body` itself — jnp ops lower inside Pallas —
-    so the two paths cannot drift.
+    so the two paths cannot drift.  ``admit_mask`` (optional [rows, S]
+    bool) rides as one extra i32 plane input; ``None`` keeps the
+    pallas_call signature (and the census op counts) unchanged.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -180,14 +194,15 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
     grid = (rows // b,)
 
     def kernel(t_ref, view_ref, ts_ref, mail_ref, cand_ref, recv_ref,
-               act_ref, son_ref, spack_ref, rows_ref,
-               view_out, ts_out, mailc_out, join_out, rm_out,
-               nf_out, size_out):
+               act_ref, son_ref, spack_ref, rows_ref, *rest):
+        (view_out, ts_out, mailc_out, join_out, rm_out,
+         nf_out, size_out) = rest[-7:]
+        admitc = None if admit_mask is None else rest[0][:] != 0
         (nv, nts, mc, join, rm, nf, sz) = _receive_body(
             n, s, tfail, tremove, stride, t_ref[0],
             view_ref[:], ts_ref[:], mail_ref[:], cand_ref[:],
             recv_ref[:] != 0, act_ref[:] != 0, son_ref[:] != 0,
-            spack_ref[:], rows_ref[:])
+            spack_ref[:], rows_ref[:], admitc)
         view_out[:] = nv
         ts_out[:] = nts
         mailc_out[:] = mc
@@ -204,18 +219,26 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
     # (_receive_body's column-vector contract).
     col_spec = pl.BlockSpec((b, 1), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # t
+        row_spec, row_spec, row_spec, row_spec,  # view/ts/mail/cand
+        col_spec, col_spec, col_spec,            # recv, act, self_on
+        col_spec, col_spec,                      # self_pack, row_ids
+    ]
+    operands = [jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
+                recv_mask.astype(I32)[:, None], act.astype(I32)[:, None],
+                self_on.astype(I32)[:, None], self_pack[:, None],
+                row_ids[:, None]]
+    if admit_mask is not None:
+        in_specs.append(row_spec)                # admit mask (i32 plane)
+        operands.append(admit_mask.astype(I32))
     from distributed_membership_tpu.observability.timeline import (
         PHASE_RECEIVE)
     with jax.named_scope(PHASE_RECEIVE):
         out = pl.pallas_call(
             kernel,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),   # t
-                row_spec, row_spec, row_spec, row_spec,  # view/ts/mail/cand
-                col_spec, col_spec, col_spec,            # recv, act, self_on
-                col_spec, col_spec,                      # self_pack, row_ids
-            ],
+            in_specs=in_specs,
             out_specs=[row_spec, row_spec, row_spec, row_spec, row_spec,
                        col_spec, col_spec],
             # Donate the big state buffers in place (view->view, ts->ts,
@@ -234,9 +257,6 @@ def receive_fused(n: int, s: int, tfail: int, tremove: int, stride: int,
                 jax.ShapeDtypeStruct((rows, 1), I32),   # size
             ],
             interpret=interpret,
-        )(jnp.asarray(t, I32).reshape(1), view, view_ts, mail, cand,
-          recv_mask.astype(I32)[:, None], act.astype(I32)[:, None],
-          self_on.astype(I32)[:, None], self_pack[:, None],
-          row_ids[:, None])
+        )(*operands)
     (view2, ts2, mailc, join_i, rm_ids, nf, size) = out
     return (view2, ts2, mailc, join_i != 0, rm_ids, nf[:, 0], size[:, 0])

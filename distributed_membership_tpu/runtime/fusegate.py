@@ -1,7 +1,7 @@
 """Auto-enable gate for the Pallas/folded fast paths (VERDICT r3 item 2).
 
-The FUSED_RECEIVE / FUSED_GOSSIP / FOLDED conf keys default to ``-1``
-(auto).  Auto resolves ON only when every link in the evidence chain
+The FUSED_RECEIVE / FUSED_GOSSIP / FUSED_PROBE / FOLDED conf keys
+default to ``-1`` (auto).  Auto resolves ON only when every link in the evidence chain
 holds; otherwise it quietly stays off (auto never raises — explicit
 ``1`` keeps today's loud structural errors):
 
@@ -20,8 +20,10 @@ holds; otherwise it quietly stays off (auto never raises — explicit
 
 The family keys mirror tpu_correctness.py's ``mismatched_elements``:
 ``fused_receive``, ``fused_gossip``, ``fused_both``,
-``fused_gossip_drops`` (the stacked kernel on lossy configs),
-``folded_s{S}``, ``folded_fused_s{S}``, and their ``sharded_`` twins.
+``fused_gossip_drops`` (the masks-as-inputs kernels on lossy/flaky
+configs), ``fused_probe`` (the fused probe/agg traversal),
+``folded_s{S}``, ``folded_fused_s{S}``,
+``folded_fused_probe_s{S}``, and their ``sharded_`` twins.
 A missing record, a non-tpu record, or a family
 absent from the record (e.g. a fold factor the correctness N could not
 fold) all read as NOT cleared — fail closed.

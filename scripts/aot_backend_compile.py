@@ -96,16 +96,26 @@ def backend_compile(params, sharding) -> None:
 # Sharded twins, compiled over the FULL 4-device abstract mesh: the
 # shard_map program (per-axis ppermute block shifts, the stacked gossip
 # kernel, [N] all_gather probe pipelines) only elaborates multi-shard.
-# (name, n, s, fused_recv, fused_gossip, drops, folded, mesh_dims)
+# (name, n, s, fused_recv, fused_gossip, fused_probe, drops, folded,
+#  mesh_dims)
 # n=1664 -> L=416 per shard makes (L*STRIDE) % S != 0: the wrapped-row
 # two-column-roll select in gossip_fused_stacked, reachable ONLY on
 # sharded layouts (single-chip N is lane-aligned by construction).
 SHARDED_VARIANTS = [
-    ("sharded_base_2x2",   4096, 128, False, False, True,  False, (2, 2)),
-    ("sharded_fboth",      4096, 128, True,  True,  False, False, (4,)),
-    ("sharded_fgossip_drops", 4096, 128, False, True, True, False, (4,)),
-    ("sharded_fgossip_wrap", 1664, 128, False, True, False, False, (4,)),
-    ("sharded_folded_fboth_s16", 4096, 16, True, True, True, True, (4,)),
+    ("sharded_base_2x2",
+     4096, 128, False, False, False, True,  False, (2, 2)),
+    ("sharded_fboth",
+     4096, 128, True,  True,  False, False, False, (4,)),
+    ("sharded_fgossip_drops",
+     4096, 128, False, True,  False, True,  False, (4,)),
+    ("sharded_fgossip_wrap",
+     1664, 128, False, True,  False, False, False, (4,)),
+    ("sharded_fprobe",
+     4096, 128, False, False, True,  True,  False, (4,)),
+    ("sharded_folded_fboth_s16",
+     4096, 16,  True,  True,  False, True,  True,  (4,)),
+    ("sharded_folded_fall_s16",
+     4096, 16,  True,  True,  True,  True,  True,  (4,)),
 ]
 
 
@@ -140,6 +150,10 @@ def main() -> int:
                          "in the traced program and asserts the round-6 "
                          "reductions (scripts/hlo_census.py; no libtpu "
                          "needed — runs in CI)")
+    ap.add_argument("--fused", action="store_true",
+                    help="with --census: run the whole-tick-fusion arm "
+                         "instead (unfused vs fully-fused droppy step; "
+                         "asserts the fused pass-count budget)")
     ap.add_argument("--probe", action="store_true",
                     help="only check whether libtpu can serve the "
                          "abstract topology, then exit — callers give "
@@ -155,7 +169,8 @@ def main() -> int:
         # The census is jaxpr-level (no topology/libtpu requirement) —
         # delegate before the TPU-support gate below.
         import hlo_census
-        sys.argv = [sys.argv[0], "--check"]
+        sys.argv = ([sys.argv[0], "--check"]
+                    + (["--fused"] if args.fused else []))
         return hlo_census.main()
 
     devices = tpu_topology_devices()
@@ -186,12 +201,12 @@ def main() -> int:
                   flush=True)
             failures.append((name, traceback.format_exc()))
 
-    for (name, n, s, fr, fg, drops, folded) in VARIANTS:
+    for (name, n, s, fr, fg, fp, drops, folded) in VARIANTS:
         if args.variant and name != args.variant:
             continue
         matched += 1
         attempt(name, lambda: backend_compile(
-            _conf(n, s, fr, fg, drops, folded), sharding))
+            _conf(n, s, fr, fg, drops, folded, fused_probe=fp), sharding))
     if not args.variant or args.variant == "approx_lag":
         matched += 1
 
@@ -213,12 +228,13 @@ def main() -> int:
             p.validate()
             return p
         attempt(sw_name, lambda f=_sw_params: backend_compile(f(), sharding))
-    for (name, n, s, fr, fg, drops, folded, dims) in SHARDED_VARIANTS:
+    for (name, n, s, fr, fg, fp, drops, folded, dims) in SHARDED_VARIANTS:
         if args.variant and name != args.variant:
             continue
         matched += 1
         attempt(name, lambda: sharded_backend_compile(
-            _conf(n, s, fr, fg, drops, folded), devices, dims))
+            _conf(n, s, fr, fg, drops, folded, fused_probe=fp),
+            devices, dims))
     if matched == 0:
         # A renamed variant must not turn the gate silently green.
         print(f"error: --variant {args.variant!r} matched nothing")

@@ -39,24 +39,33 @@ if REPO not in sys.path:
 
 def census_params(n: int, s: int, *, rng_mode: str = "batched",
                   probe_gather: str = "packed", drops: bool = False,
-                  probe_io: str = "auto", telemetry: str = "off"):
+                  probe_io: str = "auto", telemetry: str = "off",
+                  fused: bool = False, folded: bool | None = None):
     """The ladder's 1M_s16 step config (profile_step.py defaults) at
     (n, s), with the round-6 lowering knobs exposed.  ``drops`` arms the
     msgdrop-class coin streams — the regime where the batched plan
     collapses the most invocations (the drop-free step draws only the
-    thinning + shift streams)."""
+    thinning + shift streams).  ``fused`` arms the fully-fused program
+    (FOLDED + all three Pallas kernels — the whole-tick fusion arm the
+    pass-count budget pins; at S < 128 the fused kernels require the
+    folded layout).  ``folded`` (default: follows ``fused``) pins the
+    layout independently so the budget can isolate what the KERNELS buy
+    from what the fold costs."""
     from distributed_membership_tpu.config import Params
 
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
     drop_keys = ("DROP_MSG: 1\nMSG_DROP_PROB: 0.1\n" if drops
                  else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    f = int(fused)
+    fold = f if folded is None else int(folded)
     return Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
         f"TFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
         f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
-        f"FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
+        f"FUSED_RECEIVE: {f}\nFUSED_GOSSIP: {f}\nFOLDED: {fold}\n"
+        f"FUSED_PROBE: {f}\n"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
         f"PROBE_IO: {probe_io}\nTELEMETRY: {telemetry}\n"
         f"BACKEND: tpu_hash\n")
@@ -128,11 +137,18 @@ def step_census(params, scenario=None) -> dict:
     s = params.VIEW_SIZE
     counts = {"threefry_calls": 0, "big_gathers": 0,
               "big_gather_shapes": [], "big_scatters": 0,
-              "total_eqns": 0, "ns_class_ops": 0}
+              "total_eqns": 0, "ns_class_ops": 0, "pallas_calls": 0}
 
     def visit(eqn):
         name = eqn.primitive.name
         counts["total_eqns"] += 1
+        # Each fused kernel traces to one pallas_call eqn (its body is a
+        # sub-jaxpr the walk also visits — body eqns are block-sized, so
+        # they never inflate the [N, S]-class pass count below).
+        if name == "pallas_call":
+            counts["pallas_calls"] += 1
+        if not eqn.outvars:        # effect-only eqns (kernel stores)
+            return
         out_size = 1
         for d in eqn.outvars[0].aval.shape:
             out_size *= d
@@ -181,6 +197,27 @@ def full_census(n: int = 1 << 20, s: int = 16) -> dict:
     return out
 
 
+def fused_census(n: int = 1 << 20, s: int = 16) -> dict:
+    """The whole-tick-fusion structural contract at (n, s), droppy (the
+    production regime): the ``unfused`` arm is today's default jnp
+    program; the ``fused`` arm folds the planes and routes receive,
+    gossip AND the probe/agg traversal through the Pallas kernels with
+    the drop masks as kernel inputs.  tests/test_hlo_census.py pins the
+    budget: strictly fewer [N, S]-class passes, exactly three
+    pallas_calls, and zero new [N]-class gathers or scatters beyond the
+    packed probe gather (drop coins/cuts stay outside in [N, P]).  The
+    ``folded`` arm (folded layout, no kernels) isolates the layout's own
+    cross-fold gathers from the kernels' contribution: the gather budget
+    compares fused vs folded (same layout), the pass budget compares
+    fused vs both."""
+    return {"n": n, "s": s,
+            "unfused": step_census(census_params(n, s, drops=True)),
+            "folded": step_census(census_params(n, s, drops=True,
+                                                folded=True)),
+            "fused": step_census(census_params(n, s, drops=True,
+                                               fused=True))}
+
+
 def scenario_census(n: int = 1 << 20, s: int = 16) -> dict:
     """The scenario structural contract at (n, s): ``base`` (no
     scenario), ``partition`` (one two-group window — deterministic
@@ -215,6 +252,10 @@ def main() -> int:
     ap.add_argument("--scenario", action="store_true",
                     help="print the scenario-armed census (base vs "
                          "partition vs full chaos) instead")
+    ap.add_argument("--fused", action="store_true",
+                    help="print the whole-tick-fusion census (unfused vs "
+                         "fully-fused droppy step) instead; with --check, "
+                         "assert the fused pass-count budget")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero unless the default program shows "
                          "exactly one probe-leg gather and fewer "
@@ -224,6 +265,25 @@ def main() -> int:
 
     if args.scenario:
         print(json.dumps(scenario_census(args.n, args.view)))
+        return 0
+    if args.fused:
+        out = fused_census(args.n, args.view)
+        print(json.dumps(out))
+        if args.check:
+            uf, fo, fu = out["unfused"], out["folded"], out["fused"]
+            ok = (fu["ns_class_ops"] < uf["ns_class_ops"]
+                  and fu["ns_class_ops"] < fo["ns_class_ops"]
+                  and fu["pallas_calls"] == 3
+                  and fu["big_gathers"] <= fo["big_gathers"]
+                  and fu["big_scatters"] <= fo["big_scatters"]
+                  and fu["threefry_calls"] <= uf["threefry_calls"])
+            if not ok:
+                print("fused census regression: the fully-fused droppy "
+                      "step must trace to three pallas_calls, strictly "
+                      "fewer [N, S]-class passes, and no new [N]-class "
+                      "gathers/scatters or threefry draws",
+                      file=sys.stderr)
+                return 1
         return 0
     out = full_census(args.n, args.view)
     print(json.dumps(out))

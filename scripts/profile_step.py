@@ -41,6 +41,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                prng: str = "threefry2x32", shift_set: int = 0,
                rng_mode: str = "batched",
                probe_gather: str = "packed",
+               fused_probe: bool = False, drops: bool = False,
                trace_dir: str = "", runlog=None) -> dict:
     import random as _pyrandom
 
@@ -55,13 +56,21 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
 
     g = max(s // 4, 1)
     probes = max(s // 8, 1)
+    # Droppy rungs (masks-as-inputs composition on-chip): a mid-run drop
+    # window at 10%, the tpu_correctness geometry.  Such rows carry
+    # drop_prob so the bench's banked-headline scan skips them.
+    drop_keys = (
+        f"DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: {ticks // 6}\n"
+        f"DROP_STOP: {ticks - ticks // 6}\n" if drops else
+        "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
     text = (
-        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+        f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
         f"FANOUT: {fanout}\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {ticks}\n"
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\n"
         f"EXCHANGE: {exchange}\nFUSED_RECEIVE: {int(fused)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
+        f"FUSED_PROBE: {int(fused_probe)}\n"
         f"PRNG_IMPL: {prng}\nSHIFT_SET: {shift_set}\n"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
         f"BACKEND: tpu_hash\n")
@@ -149,11 +158,13 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     cfg = make_config(params, collect_events=False,
                       fail_ids=plan_fail_ids(plan))
     # Ring roofline passes (PERF.md): receive ~12 jnp / ~6 fused, gossip
-    # ~3 per shift, probe/agg ~4.
+    # ~3 per shift, probe/agg ~4 jnp / ~2 fused (one kernel traversal of
+    # view+ts instead of separate window/agg/hist sweeps).
     state_bytes = 3 * n * s * 4
     gossip_passes = ((2 * min(cfg.fanout, cfg.s) + 2) if fused_gossip
                      else 3 * min(cfg.fanout, cfg.s))
-    passes = (6 if fused else 12) + gossip_passes + 4
+    passes = ((6 if fused else 12) + gossip_passes
+              + (2 if fused_probe else 4))
     est_gb_per_tick = passes * (n * s * 4) / 1e9
 
     # Objective pass count from the compiled step itself: XLA's cost
@@ -184,6 +195,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     return {
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
+        "fused_probe": fused_probe,
+        "drop_prob": 0.1 if drops else 0,
         "prng": prng, "shift_set": shift_set,
         "rng_mode": rng_mode, "probe_gather": probe_gather,
         "fanout": cfg.fanout, "probes": cfg.probes,
@@ -235,6 +248,15 @@ def main() -> int:
                     help="probe/ack pipeline gather lowering: packed = "
                          "one combined [N, 2P] gather (default), split "
                          "= the two-gather pre-round-6 arm (bit-exact)")
+    ap.add_argument("--fused-probe", default="off", choices=["off", "on"],
+                    help="FUSED_PROBE: the single-traversal probe-window "
+                         "+ agg + hist Pallas kernel (ops/fused_probe; "
+                         "needs ring + S %% 128 == 0, or FOLDED for "
+                         "S < 128)")
+    ap.add_argument("--drops", default="off", choices=["off", "on"],
+                    help="arm a mid-run 10%% drop window (the "
+                         "masks-as-inputs composition rungs; rows carry "
+                         "drop_prob and are excluded from headline perf)")
     ap.add_argument("--cost", action="store_true",
                     help="add XLA cost-analysis fields (recompiles: ~2x "
                          "rung wall time)")
@@ -271,6 +293,8 @@ def main() -> int:
                              shift_set=args.shift_set,
                              rng_mode=args.rng_mode,
                              probe_gather=args.probe_gather,
+                             fused_probe=args.fused_probe == "on",
+                             drops=args.drops == "on",
                              trace_dir=args.trace_dir, runlog=runlog)
             print(json.dumps(rec), flush=True)
     return 0

@@ -6,7 +6,8 @@ script closes the remaining gap — the actual Mosaic TPU lowering — by
 running the full `tpu_hash` scan under each mode on the real chip (same
 seed) and comparing final states bit-for-bit: the receive kernel under
 drops, the gossip kernel and the two-kernel composition drop-free, the
-stacked gossip kernel under drops, and the folded S=16 layout vs the
+masks-as-inputs gossip kernel under drops, the fused probe/agg
+traversal (natural + folded), and the folded S=16 layout vs the
 natural one (droppy).  Exit 0 = all identical.  The comparison is
 same-platform only: each variant vs the baseline on whatever backend
 resolve_platform selects.
@@ -26,7 +27,8 @@ sys.path.insert(0, REPO)
 
 def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
              n: int = 8192, s: int = 128, ticks: int = 60,
-             folded: bool = False, sharded: bool = False):
+             folded: bool = False, sharded: bool = False,
+             fused_probe: bool = False):
     """One full scan; returns the flattened final-state pytree.
 
     ``sharded`` runs the SAME config on BACKEND tpu_hash_sharded over a
@@ -57,7 +59,7 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
-        f"BACKEND: {backend}\n")
+        f"FUSED_PROBE: {int(fused_probe)}\nBACKEND: {backend}\n")
     plan = make_plan(params, _pyrandom.Random("app:0"))
     if sharded:
         from distributed_membership_tpu.backends.tpu_hash_sharded import (
@@ -124,6 +126,12 @@ def main() -> int:
         # only the lossy configs' auto knob.
         goss_d = run_once(False, True, True, n=args.n, ticks=args.ticks)
         checks["fused_gossip_drops"] = diff(base_d, goss_d)
+        # Fused probe/agg traversal (ops/fused_probe) under the droppy
+        # config — drop coins stay OUTSIDE the kernel in [N,P] space, so
+        # this exercises exactly the composition the auto knob would ship.
+        prob_d = run_once(False, False, True, n=args.n, ticks=args.ticks,
+                          fused_probe=True)
+        checks["fused_probe"] = diff(base_d, prob_d)
         # Gossip kernel (single-payload, drop-free), alone and with the
         # receive kernel — the composition FUSED defaults would ship.
         base = run_once(False, False, False, n=args.n, ticks=args.ticks)
@@ -162,6 +170,13 @@ def main() -> int:
         checks[f"folded_fused_s{s_f}"] = {
             k: int((fold_f[k].reshape(-1) != ffus_f[k].reshape(-1)).sum())
             for k in fold_f}
+        # Folded fused probe kernel (segment-aware rolls + det_any plane)
+        # vs the jnp folded step, droppy.  Gates the *_fprobe ladder rungs.
+        fprb_f = run_once(False, False, True, n=args.n, s=s_f,
+                          ticks=args.ticks, folded=True, fused_probe=True)
+        checks[f"folded_fused_probe_s{s_f}"] = {
+            k: int((fold_f[k].reshape(-1) != fprb_f[k].reshape(-1)).sum())
+            for k in fold_f}
 
     # Sharded arm (run_once's ``sharded`` flag): the same scans inside
     # shard_map on one chip, gating the sharded backend's auto knobs.
@@ -174,6 +189,9 @@ def main() -> int:
         sh_goss_d = run_once_s(False, True, True, n=args.n,
                                ticks=args.ticks)
         checks["sharded_fused_gossip_drops"] = diff(sh_base_d, sh_goss_d)
+        sh_prob_d = run_once_s(False, False, True, n=args.n,
+                               ticks=args.ticks, fused_probe=True)
+        checks["sharded_fused_probe"] = diff(sh_base_d, sh_prob_d)
         sh_base = run_once_s(False, False, False, n=args.n,
                              ticks=args.ticks)
         sh_goss = run_once_s(False, True, False, n=args.n,
@@ -199,6 +217,12 @@ def main() -> int:
                                   ticks=args.ticks, folded=True)
         checks[f"sharded_folded_fused_s{s_f}"] = {
             k: int((shf_f[k].reshape(-1) != shff_f[k].reshape(-1)).sum())
+            for k in shf_f}
+        shfp_f = run_once_s(False, False, True, n=args.n, s=s_f,
+                            ticks=args.ticks, folded=True,
+                            fused_probe=True)
+        checks[f"sharded_folded_fused_probe_s{s_f}"] = {
+            k: int((shf_f[k].reshape(-1) != shfp_f[k].reshape(-1)).sum())
             for k in shf_f}
 
     mism = {name: {k: v for k, v in d.items() if v}

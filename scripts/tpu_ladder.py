@@ -70,7 +70,11 @@ def _events():
 # 'off' | 'recv' (Pallas receive kernel) | 'gossip' (Pallas gossip
 # delivery) | 'both' | 'folded' (the [N/F, 128] layout for S < 128)
 # | 'folded_fboth' (folded layout + BOTH folded-fused Pallas kernels,
-# ops/fused_folded — the north-star combination, PERF.md roofline).
+# ops/fused_folded — the north-star combination, PERF.md roofline)
+# | 'folded_fprobe' (+ the fused probe/agg traversal, ops/fused_probe)
+# | 'folded_fboth_drop' (fboth with a 10% drop window armed — the
+# masks-as-inputs composition) | 'folded_fall' (every kernel at once:
+# whole-tick fusion).
 # The special correctness rungs run scripts/tpu_correctness.py (full
 # scans on the chip, final states bit-compared) instead of a timing
 # point; a failing family gates only its own timing rungs.  They are
@@ -190,6 +194,17 @@ LADDER = [
     ("1M_s16_folded",    1 << 20,  16,  60, "folded", 1200),
     ("1M_s16_folded_v2", 1 << 20,  16,  60, "folded", 1200),
     ("1M_s16_folded_fboth", 1 << 20, 16, 60, "folded_fboth", 1200),
+    # Whole-tick fusion rungs.  fprobe: the single-traversal probe/agg
+    # kernel (ops/fused_probe, folded twin at S=16) against the banked
+    # folded rows.  fboth_drop: BOTH transport kernels with a 10%
+    # mid-run drop window — prices the masks-as-inputs composition
+    # (drop masks become kernel operands instead of disabling the
+    # kernels); its row carries drop_prob so it never becomes the
+    # headline.  fall: every kernel in one step — the whole-tick-fusion
+    # north star the PERF.md pass table models.
+    ("1M_s16_fprobe",    1 << 20,  16,  60, "folded_fprobe", 1200),
+    ("1M_s16_fboth_drop", 1 << 20, 16,  60, "folded_fboth_drop", 1200),
+    ("1M_s16_fall",      1 << 20,  16,  60, "folded_fall", 1200),
     ("524k_s64",         1 << 19,  64,  60, "off",    600),
     ("1M_s64_folded",    1 << 20,  64,  60, "folded", 900),
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
@@ -329,12 +344,22 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                os.path.join(REPO, "scripts", "profile_step.py"),
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
                "--fused",
-               "on" if fused in ("recv", "both", "folded_fboth") else "off",
-               "--fused-gossip",
-               "on" if fused in ("gossip", "both", "folded_fboth")
+               "on" if fused in ("recv", "both", "folded_fboth",
+                                 "folded_fboth_drop", "folded_fall")
                else "off",
+               "--fused-gossip",
+               "on" if fused in ("gossip", "both", "folded_fboth",
+                                 "folded_fboth_drop", "folded_fall")
+               else "off",
+               "--fused-probe",
+               "on" if fused in ("folded_fprobe", "folded_fall")
+               else "off",
+               "--drops",
+               "on" if fused.endswith("_drop") else "off",
                "--folded",
-               "on" if fused in ("folded", "folded_fboth", "folded_sw16")
+               "on" if fused in ("folded", "folded_fboth", "folded_sw16",
+                                 "folded_fprobe", "folded_fboth_drop",
+                                 "folded_fall")
                else "off",
                "--shift-set",
                "16" if fused in ("sw16", "folded_sw16") else "0",
@@ -484,9 +509,16 @@ def _rung_gated(rung, corr) -> bool:
     # LAYOUT's banked bit-exactness family clean: it falls through to
     # the trailing folded_s{view} logic below (incl. the detail-free
     # fail-closed guard), exactly like plain 'folded'.
-    if mode == "folded_fboth" and not _corr_covers_ladder(corr):
+    if (mode in ("folded_fboth", "folded_fboth_drop")
+            and not _corr_covers_ladder(corr)):
         # The verdict predates the folded_fused families: fail closed
         # until a covering correctness run lands (_missing re-arms it).
+        return True
+    if (mode in ("folded_fprobe", "folded_fall")
+            and not any(k.startswith("folded_fused_probe")
+                        for k in corr.get("mismatched_elements", {}))):
+        # Same fail-closed rule for the probe-kernel families: a verdict
+        # from before fused_probe existed must not green-light its rungs.
         return True
     if corr.get("ok", False):
         return False
@@ -496,11 +528,16 @@ def _rung_gated(rung, corr) -> bool:
     if mode in PALLAS_MODES:
         return any(mism.get(k) for k in ("fused_receive", "fused_gossip",
                                          "fused_both"))
-    if mode == "folded_fboth":
-        # Needs BOTH the folded layout and its fused twins clean at this
-        # fold factor; missing per-factor detail falls back to any
-        # folded/folded_fused failure (conservative).
-        keys = (f"folded_s{view}", f"folded_fused_s{view}")
+    if mode in ("folded_fboth", "folded_fboth_drop", "folded_fprobe",
+                "folded_fall"):
+        # Needs the folded layout and every fused twin the mode pins
+        # clean at this fold factor; missing per-factor detail falls
+        # back to any folded/folded_fused failure (conservative).
+        keys = (f"folded_s{view}",)
+        if mode != "folded_fprobe":
+            keys += (f"folded_fused_s{view}",)
+        if mode in ("folded_fprobe", "folded_fall"):
+            keys += (f"folded_fused_probe_s{view}",)
         if any(k in mism for k in keys):
             return any(bool(mism.get(k)) for k in keys)
         return any(bool(v) for k, v in mism.items()
@@ -532,16 +569,21 @@ def _corr_covers_ladder(rec) -> bool:
 # covered, without smearing onto families another arm re-checks.
 ARM_FAMILIES = {
     "fused_correctness": ("fused_receive", "fused_gossip", "fused_both",
-                          "fused_gossip_drops"),
+                          "fused_gossip_drops", "fused_probe"),
     "folded_correctness": ("folded_s16", "folded_fused_s16",
-                           "folded_s64", "folded_fused_s64"),
+                           "folded_fused_probe_s16",
+                           "folded_s64", "folded_fused_s64",
+                           "folded_fused_probe_s64"),
     "sharded_correctness": ("sharded_fused_receive",
                             "sharded_fused_gossip", "sharded_fused_both",
                             "sharded_fused_gossip_drops",
+                            "sharded_fused_probe",
                             "sharded_folded_s16",
                             "sharded_folded_fused_s16",
+                            "sharded_folded_fused_probe_s16",
                             "sharded_folded_s64",
-                            "sharded_folded_fused_s64"),
+                            "sharded_folded_fused_s64",
+                            "sharded_folded_fused_probe_s64"),
 }
 
 

@@ -151,7 +151,12 @@ def test_fused_mode_strings(tmp_path):
     for flags, want in [({"fused": True}, "fused:recv"),
                         ({"fused_gossip": True}, "fused:gossip"),
                         ({"fused": True, "fused_gossip": True},
-                         "fused:both")]:
+                         "fused:both"),
+                        ({"fused_probe": True}, "fused:probe"),
+                        ({"fused": True, "fused_probe": True},
+                         "fused:recv+probe"),
+                        ({"fused": True, "fused_gossip": True,
+                          "fused_probe": True}, "fused:all")]:
         _write(tmp_path, "TPU_PROFILE.json", [
             {"platform": "tpu", "rung": "x", "n": 1 << 16, "s": 128,
              "ticks": 100, "wall_seconds": 10.0, "ticks_per_sec": 10.0,

@@ -108,9 +108,11 @@ def test_fused_run_matches_default_end_to_end():
 
 def test_fused_gossip_with_drops_end_to_end():
     """A LOSSY config under FUSED_GOSSIP=1 must reproduce the unfused
-    lossy run exactly: the step pre-masks each shift's payload with the
-    same fold_in Bernoulli draws the jnp loop makes and routes through
-    the stacked kernel (tpu_hash.make_step droppy-fused branch)."""
+    lossy run exactly: the step computes each shift's keep mask OUTSIDE
+    the kernel with the same batched coin draws the jnp loop makes and
+    hands the [K, N, S] mask stack to ``gossip_fused`` as a kernel
+    input (tpu_hash.make_step droppy-fused branch) — the payload itself
+    stays one unmasked tensor."""
     import random
 
     from distributed_membership_tpu.backends.tpu_hash import run_scan
@@ -137,6 +139,77 @@ def test_fused_gossip_with_drops_end_to_end():
     np.testing.assert_array_equal(np.asarray(fs0.view_ts),
                                   np.asarray(fs1.view_ts))
     np.testing.assert_array_equal(np.asarray(fs0.mail), np.asarray(fs1.mail))
+
+
+def test_fused_masks_matches_loop():
+    """``gossip_fused`` with the [K, N, S] keep-mask stack == the jnp
+    shift loop applying the same sender-indexed masks before the rolls.
+    The masks subsume the k_eff fanout gate, so the reference folds it
+    into the mask itself — exactly what the droppy step branch does."""
+    n, s, k_max = 256, 128, 3
+    cstride = STRIDE % s
+    key = jax.random.PRNGKey(11)
+    ks = jax.random.split(key, 5)
+    mail = jax.random.randint(ks[0], (n, s), 0, 1 << 20).astype(jnp.uint32)
+    payload = jax.random.randint(ks[1], (n, s), 1,
+                                 1 << 20).astype(jnp.uint32)
+    shifts = jax.random.randint(ks[2], (k_max,), 1, n)
+    k_eff = jax.random.randint(ks[3], (n,), 0, k_max + 1)
+    keep = jax.random.bernoulli(ks[4], 0.8, (k_max, n, s))
+    masks = (keep & (jnp.arange(k_max)[:, None, None]
+                     < k_eff[None, :, None])).astype(jnp.int32)
+
+    ref = mail
+    for j in range(k_max):
+        masked = jnp.where(masks[j] != 0, payload, jnp.uint32(0))
+        s1 = (int(shifts[j]) % s) * cstride % s
+        ref = jnp.maximum(ref, jnp.roll(jnp.roll(masked, shifts[j],
+                                                 axis=0), s1, axis=1))
+    got = gossip_fused(n, s, k_max, True, mail, payload, k_eff, shifts,
+                       masks=masks)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+@pytest.mark.slow
+def test_stacked_kernel_masks_matches_loop():
+    """``gossip_fused_stacked`` with masks: the [K, L, S] keep stack is
+    applied in-VMEM after sender-row assembly, and a SHARED [1, L, S]
+    payload broadcasts across shifts (the single-chip lossy branch's
+    no-copy trick) — both against the jnp loop, both column regimes."""
+    from distributed_membership_tpu.ops.fused_gossip import (
+        gossip_fused_stacked)
+
+    for rows, s, k, single, shared, seed in [(256, 128, 3, True, True, 3),
+                                             (64, 128, 4, False, True, 4),
+                                             (256, 128, 2, True, False, 5)]:
+        key = jax.random.PRNGKey(seed)
+        ks = jax.random.split(key, 6)
+        kp = 1 if shared else k
+        mail = jax.random.randint(ks[0], (rows, s), 0,
+                                  1 << 20).astype(jnp.uint32)
+        payloads = jax.random.randint(ks[1], (kp, rows, s), 1,
+                                      1 << 20).astype(jnp.uint32)
+        cs = jax.random.randint(ks[2], (k,), 0, rows)
+        s1s = jax.random.randint(ks[3], (k,), 0, s)
+        s2s = (s1s + 7) % s
+        masks = jax.random.bernoulli(ks[4], 0.7,
+                                     (k, rows, s)).astype(jnp.int32)
+
+        ref = mail
+        idx = jnp.arange(rows)
+        for j in range(k):
+            masked = jnp.where(masks[j] != 0, payloads[0 if shared else j],
+                               jnp.uint32(0))
+            rolled = jnp.roll(masked, cs[j], axis=0)
+            r1 = jnp.roll(rolled, s1s[j], axis=1)
+            d = r1 if single else jnp.where(
+                (idx >= cs[j])[:, None], r1,
+                jnp.roll(rolled, s2s[j], axis=1))
+            ref = jnp.maximum(ref, d)
+        got = gossip_fused_stacked(rows, s, k, single, True, mail,
+                                   payloads, cs, s1s, s2s, masks=masks)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got),
+                                      err_msg=f"{rows},{s},{k},{shared}")
 
 
 def test_fused_gossip_with_budget_rejected():

@@ -1,0 +1,330 @@
+"""Fused probe/agg traversal (ops/fused_probe) == the unfused lowering.
+
+Three layers, all bit-exact:
+
+* **Kernel units** — the natural and folded kernels in interpret mode
+  against independent jnp references: the validated window-id plane, the
+  staleness/suspicion bucket partials against the REAL builder
+  (observability/timeline.hist_bucket_counts — pinning that the in-kernel
+  shift+clip bucket index cannot fork from the ``//``-based one), and the
+  FastAgg removal/detection partials.
+* **End-to-end twins** — FUSED_PROBE=1 must reproduce the unfused droppy
+  run exactly on every ring twin, including the FULL telemetry tree
+  (``TELEMETRY: hist`` — the fused kernel supplies the staleness/
+  suspicion counts as row partials) and the detection summary (FastAgg
+  rides the kernel's column partials).
+* **All-fused chaos** — FUSED_RECEIVE+FUSED_GOSSIP+FUSED_PROBE together
+  under a full scenario (partition + crash + restart + link_flake) vs
+  the all-off run: the PR's composition contract — drop coins and
+  scenario cuts stay OUTSIDE the kernels and compose bit-exactly.
+
+Interpret mode needs no TPU; the Mosaic lowering is gated devicelessly
+by tests/test_tpu_lowering.py and on hardware by
+scripts/tpu_correctness.py (families ``fused_probe`` /
+``folded_fused_probe_s{S}`` + sharded twins).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.observability.timeline import (
+    STALENESS_BUCKET_TICKS, hist_bucket_counts)
+from distributed_membership_tpu.ops.fused_probe import (
+    _NB, probe_folded_window_fused, probe_fused_supported,
+    probe_window_fused)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# Kernel units (interpret mode vs jnp references)
+
+
+def _random_probe_state(key, n, s, t):
+    ks = jax.random.split(key, 6)
+    ids = jax.random.randint(ks[0], (n, s), 0, n)
+    occ = jax.random.bernoulli(ks[1], 0.7, (n, s))
+    view = jnp.where(occ, ids.astype(U32) + 1, U32(0))
+    view_ts = jax.random.randint(ks[2], (n, s), 0, t + 1)
+    act = jax.random.bernoulli(ks[3], 0.9, (n,))
+    # Removal plane: mostly EMPTY (-1) with a sprinkle of real ids.
+    rm = jnp.where(jax.random.bernoulli(ks[4], 0.1, (n, s)),
+                   jax.random.randint(ks[5], (n, s), 0, n), -1)
+    return view, view_ts, act, rm.astype(I32)
+
+
+def _reference(n, s, p_cnt, tfail, fail_ids, t, ptr, view, view_ts, act,
+               rm):
+    """Independent jnp lowering of the fused traversal's outputs, in the
+    NATURAL layout (the folded test reshapes these)."""
+    rolled = jnp.roll(view, (s - ptr) % s, axis=1)
+    pres = rolled > 0
+    w_id = ((rolled - U32(1)) % U32(n)).astype(I32)
+    node = jnp.arange(n, dtype=I32)[:, None]
+    valid = pres & (w_id != node) & act[:, None]
+    ids = jnp.where(valid, w_id.astype(U32) + U32(1), U32(0))
+
+    difft = t - view_ts
+    present = view > 0
+    stale = hist_bucket_counts(difft, present, _NB,
+                               STALENESS_BUCKET_TICKS)
+    susp = hist_bucket_counts(difft - tfail, present & (difft >= tfail),
+                              _NB, STALENESS_BUCKET_TICKS)
+    rm_total = (rm >= 0).sum(dtype=I32)
+    det = det_any = None
+    if fail_ids:
+        det = jnp.stack([(rm == f).sum(dtype=I32) for f in fail_ids])
+        det_any = (rm[..., None] == jnp.asarray(fail_ids)).any(-1)
+    return ids, stale, susp, rm_total, det, det_any
+
+
+@pytest.mark.parametrize("n,s,p_cnt,t", [
+    (64, 128, 16, 37),
+    pytest.param(256, 128, 16, 9, marks=pytest.mark.slow),
+    pytest.param(24, 256, 40, 100, marks=pytest.mark.slow),
+])
+def test_probe_window_fused_matches_reference(n, s, p_cnt, t):
+    assert probe_fused_supported(n, s, p_cnt)
+    tfail, fail_ids = 16, (3, 5)
+    ptr = (t * p_cnt) % s
+    view, view_ts, act, rm = _random_probe_state(
+        jax.random.PRNGKey(n + t), n, s, t)
+
+    ids, stale, susp, rm_total, det, _ = _reference(
+        n, s, p_cnt, tfail, fail_ids, t, ptr, view, view_ts, act, rm)
+    pfo = probe_window_fused(n, s, p_cnt, tfail, fail_ids, True, True,
+                             True, jnp.asarray(t, I32),
+                             jnp.asarray(ptr, I32), jnp.zeros((), I32),
+                             view, view_ts, act, rm)
+    wp = pfo["ids"].shape[1]
+    np.testing.assert_array_equal(np.asarray(pfo["ids"]),
+                                  np.asarray(ids[:, :wp]))
+    np.testing.assert_array_equal(np.asarray(pfo["stale_rows"].sum(0)),
+                                  np.asarray(stale))
+    np.testing.assert_array_equal(np.asarray(pfo["susp_rows"].sum(0)),
+                                  np.asarray(susp))
+    assert int(pfo["rm_cnt"].sum()) == int(rm_total)
+    got_det = [int(d.sum()) for d in pfo["det_cols"]]
+    assert got_det == [int(x) for x in det]
+
+
+def test_probe_window_fused_minimal_outputs():
+    """want_hist/want_agg off: only the id plane comes back (the event
+    and scalars-tier configs must not pay for unused outputs)."""
+    n, s, p_cnt, t = 64, 128, 16, 21
+    view, view_ts, act, rm = _random_probe_state(
+        jax.random.PRNGKey(5), n, s, t)
+    pfo = probe_window_fused(n, s, p_cnt, 16, (), False, False, True,
+                             jnp.asarray(t, I32), jnp.asarray(4, I32),
+                             jnp.zeros((), I32), view, None, act, None)
+    assert set(pfo) == {"ids"}
+    ids, *_ = _reference(n, s, p_cnt, 16, (), t, 4, view, view_ts, act,
+                         rm)
+    np.testing.assert_array_equal(np.asarray(pfo["ids"]),
+                                  np.asarray(ids[:, :pfo["ids"].shape[1]]))
+
+
+@pytest.mark.parametrize("n,s,t", [
+    (128, 16, 37),
+    pytest.param(64, 32, 9, marks=pytest.mark.slow),
+])
+def test_probe_folded_window_fused_matches_reference(n, s, t):
+    """Folded planes: segment-wise rolls, per-segment node ids, the full
+    S-folded id plane, and the extra det_any plane — all against the
+    natural reference reshaped to the [N*S/128, 128] layout."""
+    f = 128 // s
+    rows = n // f
+    p_cnt = max(s // 8, 1)
+    tfail, fail_ids = 16, (3, 5)
+    ptr = (t * p_cnt) % s
+    view, view_ts, act, rm = _random_probe_state(
+        jax.random.PRNGKey(2 * n + t), n, s, t)
+    fold = lambda x: x.reshape(rows, 128)        # noqa: E731
+    actp = jnp.repeat(act, s).reshape(rows, 128)
+
+    ids, stale, susp, rm_total, det, det_any = _reference(
+        n, s, p_cnt, tfail, fail_ids, t, ptr, view, view_ts, act, rm)
+    pfo = probe_folded_window_fused(
+        n, s, p_cnt, tfail, fail_ids, True, True, True,
+        jnp.asarray(t, I32), jnp.asarray(ptr, I32), jnp.zeros((), I32),
+        fold(view), fold(view_ts), actp, fold(rm))
+    np.testing.assert_array_equal(np.asarray(pfo["ids"]),
+                                  np.asarray(fold(ids)))
+    np.testing.assert_array_equal(np.asarray(pfo["stale_rows"].sum(0)),
+                                  np.asarray(stale))
+    np.testing.assert_array_equal(np.asarray(pfo["susp_rows"].sum(0)),
+                                  np.asarray(susp))
+    assert int(pfo["rm_cnt"].sum()) == int(rm_total)
+    assert [int(d.sum()) for d in pfo["det_cols"]] \
+        == [int(x) for x in det]
+    np.testing.assert_array_equal(np.asarray(pfo["det_any"] != 0),
+                                  np.asarray(fold(det_any)))
+
+
+def test_fused_probe_structural_rejections():
+    base = ("MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nGOSSIP_LEN: 16\nPROBES: {p}\nTFAIL: 16\n"
+            "TREMOVE: 64\nTOTAL_TIME: 100\nFAIL_TIME: 50\n"
+            "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+            "FUSED_PROBE: 1\n"
+            "VIEW_SIZE: {s}\nFOLDED: {f}\nBACKEND: tpu_hash\n")
+    from distributed_membership_tpu.backends.tpu_hash import make_config
+
+    # Natural layout needs lane-aligned rows (S % 128 == 0).
+    with pytest.raises(ValueError, match="FUSED_PROBE needs"):
+        make_config(Params.from_text(base.format(n=256, p=8, s=64, f=0)))
+    # Folded layout: a plane too short for the kernel grid must reject
+    # loudly (N*S/128 >= 8 plane rows — same gate as the other kernels).
+    with pytest.raises(ValueError, match="8 plane rows"):
+        make_config(Params.from_text(base.format(n=8, p=16, s=64, f=1)),
+                    collect_events=False)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end twins: FUSED_PROBE on == off, droppy, full telemetry tree.
+
+
+_E2E_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+    "DROP_START: 10\nDROP_STOP: 50\nGOSSIP_LEN: {g}\nPROBES: {p}\n"
+    "FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+    "VIEW_SIZE: {s}\nJOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "TELEMETRY: hist\n")
+
+
+def _assert_same_run(r0, r1):
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    tl0, tl1 = r0.extra["timeline"], r1.extra["timeline"]
+    assert set(tl0) == set(tl1)
+    for k in tl0:
+        np.testing.assert_array_equal(np.asarray(tl0[k]),
+                                      np.asarray(tl1[k]), err_msg=k)
+
+
+@pytest.mark.parametrize("extra", [
+    pytest.param("BACKEND: tpu_hash\n", marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash\nFOLDED: 1\n",
+                 marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash_sharded\n",
+                 marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash_sharded\nFOLDED: 1\n",
+                 marks=pytest.mark.slow),
+], ids=["natural", "folded", "sharded", "sharded_folded"])
+def test_fused_probe_e2e_droppy(extra):
+    """FUSED_PROBE=1 reproduces the unfused droppy run exactly on each
+    ring twin — trajectory, detection summary, and every telemetry
+    series including the kernel-supplied staleness/suspicion
+    histograms."""
+    import warnings
+
+    backend = ("tpu_hash_sharded" if "sharded" in extra else "tpu_hash")
+    folded = "FOLDED" in extra
+    # The sharded folded twin needs the per-shard row count to fold at
+    # the default virtual mesh: L must be a multiple of 128/P.
+    n = 512 if (folded and "sharded" in extra) else 256
+    conf = _E2E_CONF.format(n=n, s=16 if folded else 128,
+                            g=8 if folded else 16,
+                            p=2 if folded else 16)
+
+    def run(fp):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend(backend)(
+                Params.from_text(conf + extra + f"FUSED_PROBE: {fp}\n"),
+                seed=3)
+
+    _assert_same_run(run(0), run(1))
+
+
+# ---------------------------------------------------------------------------
+# All-fused under scenario chaos: the composition contract.
+
+
+def _chaos_events(n):
+    return [
+        {"kind": "partition", "start": 20, "stop": 80,
+         "groups": [[0, n // 2], [n // 2, n]]},
+        {"kind": "crash", "time": 30, "range": [4, 8]},
+        {"kind": "restart", "time": 100, "range": [4, 8]},
+        {"kind": "link_flake", "start": 110, "stop": 150,
+         "src": [0, n // 2], "dst": [n // 2, n], "drop_prob": 0.2},
+    ]
+
+
+_CHAOS_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "GOSSIP_LEN: {g}\nPROBES: {p}\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+    "TOTAL_TIME: 170\nVIEW_SIZE: {s}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+    "EXCHANGE: ring\nTELEMETRY: scalars\n")
+
+
+@pytest.mark.parametrize("extra", [
+    "BACKEND: tpu_hash\n",
+    pytest.param("BACKEND: tpu_hash\nFOLDED: 1\n",
+                 marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash_sharded\n",
+                 marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash_sharded\nFOLDED: 1\n",
+                 marks=pytest.mark.slow),
+], ids=["natural", "folded", "sharded", "sharded_folded"])
+def test_all_fused_chaos_bit_exact(extra, tmp_path):
+    """Every fused knob on (receive + gossip masks-as-inputs + probe)
+    under partition + crash + restart + link_flake == the all-off run,
+    bit-exactly: scenario cuts reach the gossip kernel as mask inputs
+    and suppress probes OUTSIDE the probe kernel, so chaos composes
+    with whole-tick fusion with zero trajectory drift."""
+    import warnings
+
+    backend = ("tpu_hash_sharded" if "sharded" in extra else "tpu_hash")
+    folded = "FOLDED" in extra
+    n = 512 if (folded and "sharded" in extra) else 256
+    spath = tmp_path / "chaos.json"
+    spath.write_text(json.dumps({"name": "chaos",
+                                 "events": _chaos_events(n)}))
+    conf = (_CHAOS_CONF.format(n=n, s=16 if folded else 128,
+                               g=8 if folded else 16,
+                               p=2 if folded else 16)
+            + f"SCENARIO: {spath}\n" + extra)
+
+    def run(on):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend(backend)(
+                Params.from_text(conf + f"FUSED_RECEIVE: {on}\n"
+                                 f"FUSED_GOSSIP: {on}\n"
+                                 f"FUSED_PROBE: {on}\n"),
+                seed=5)
+
+    r0, r1 = run(0), run(1)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    assert (r0.extra["scenario_report"] == r1.extra["scenario_report"])
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    # The chaos actually happened (partition caused false removals and
+    # the restarted block rejoined) — guard against a silently inert
+    # scenario making the bit-equality vacuous.
+    rep = r0.extra["scenario_report"]
+    assert rep["partitions"][0]["removals_during"] > 0
+    assert rep["restarts"][0]["rejoined"] is True

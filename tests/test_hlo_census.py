@@ -150,6 +150,45 @@ def test_scenario_census_bounded_at_1m_s16():
 
 
 @pytest.mark.quick
+def test_fused_census_budget_at_1m_s16():
+    """Whole-tick-fusion structural budget at the [1M, 16] north-star
+    geometry, droppy (scripts/hlo_census.py fused_census): the
+    fully-fused step (FOLDED + receive/gossip/probe Pallas kernels with
+    the drop masks as kernel inputs) must trace to
+
+      * exactly THREE pallas_call eqns (one per kernel — the whole tick
+        rides three fused traversals),
+      * strictly fewer [N, S]-class passes than BOTH unfused arms (the
+        natural jnp step and the folded jnp step), pinned at the
+        measured count with small slack,
+      * zero new [N]-class gathers or scatters over the folded-unfused
+        arm (same layout — the kernels add none; drop coins and probe
+        cuts stay outside in [N, P] space), and
+      * no new threefry invocations (the masks are drawn from the same
+        batched streams the unfused step consumes).
+    """
+    out = hlo_census.fused_census(n=1 << 20, s=16)
+    uf, fo, fu = out["unfused"], out["folded"], out["fused"]
+
+    assert fu["pallas_calls"] == 3, fu
+    assert uf["pallas_calls"] == 0 and fo["pallas_calls"] == 0
+
+    # Pass budget: the fused step must stay strictly under both unfused
+    # arms; the pin (measured 218 vs 291 natural / 461 folded) keeps a
+    # regression that quietly re-materializes a plane pass loud.
+    assert fu["ns_class_ops"] < uf["ns_class_ops"], (fu, uf)
+    assert fu["ns_class_ops"] < fo["ns_class_ops"], (fu, fo)
+    assert fu["ns_class_ops"] <= 240, fu["ns_class_ops"]
+
+    # Same-layout gather/scatter budget: the kernels may not add any
+    # [N]-class gather or scatter beyond what the folded layout itself
+    # performs (window_idx compaction, cross-fold plumbing).
+    assert fu["big_gathers"] <= fo["big_gathers"], (fu, fo)
+    assert fu["big_scatters"] <= fo["big_scatters"], (fu, fo)
+    assert fu["threefry_calls"] <= uf["threefry_calls"], (fu, uf)
+
+
+@pytest.mark.quick
 def test_census_exact_mode_single_gather():
     """PROBE_IO exact (the default below 2^17) also rides the single
     combined gather — the DEFAULT exact path was the tentpole's target,

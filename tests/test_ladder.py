@@ -161,6 +161,45 @@ def test_partial_correctness_arms_fail_closed_and_accumulate(tmp_path):
     assert "1M_s16_folded" in rungs
 
 
+def test_fused_probe_rungs_fail_closed_until_covered(tmp_path):
+    """The whole-tick-fusion rungs (fprobe / fall) gate on the
+    folded_fused_probe correctness families: a folded-arm verdict from
+    before those checks existed must leave them CLOSED (while fboth,
+    whose families it does cover, opens), and a verdict covering them
+    clean opens them; a dirty probe family gates only the probe rungs."""
+    lad = _load_ladder(tmp_path)
+    lad.append({"rung": lad.FOLDED_CORR_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {"folded_s16": {},
+                                        "folded_fused_s16": {}}})
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    assert "1M_s16_fprobe" not in rungs       # predates probe families
+    assert "1M_s16_fall" not in rungs
+    assert "1M_s16_folded_fboth" in rungs
+    assert "1M_s16_fboth_drop" in rungs
+    # A covering verdict with the probe families clean opens them.
+    lad.append({"rung": lad.FOLDED_CORR_RUNG[0], "platform": "tpu",
+                "check": "fused_vs_jnp_same_platform", "ok": True,
+                "mismatched_elements": {"folded_s16": {},
+                                        "folded_fused_s16": {},
+                                        "folded_fused_probe_s16": {}}})
+    rungs = {r[0]: r[4] for r in lad._missing()}
+    assert "1M_s16_fprobe" in rungs
+    assert "1M_s16_fall" in rungs
+    # A dirty probe family gates fprobe/fall but not fboth.
+    lad2 = _load_ladder(tmp_path / "b")
+    (tmp_path / "b").mkdir()
+    lad2.append({"rung": lad2.FOLDED_CORR_RUNG[0], "platform": "tpu",
+                 "check": "fused_vs_jnp_same_platform", "ok": False,
+                 "mismatched_elements": {
+                     "folded_s16": {}, "folded_fused_s16": {},
+                     "folded_fused_probe_s16": {".view": 3}}})
+    rungs = {r[0]: r[4] for r in lad2._missing()}
+    assert "1M_s16_fprobe" not in rungs
+    assert "1M_s16_fall" not in rungs
+    assert "1M_s16_folded_fboth" in rungs
+
+
 class _FakeProc:
     returncode = 0
     stderr = ""

@@ -40,7 +40,7 @@ TICKS = 60   # scan length is trace-invariant (body traced once); this
 
 
 def _conf(n: int, s: int, fused_recv: bool, fused_gossip: bool,
-          drops: bool, folded: bool) -> Params:
+          drops: bool, folded: bool, fused_probe: bool = False) -> Params:
     """Mirror scripts/tpu_correctness.py's run_once param construction —
     the lowering gate must cover the exact configs the hardware gate
     runs."""
@@ -56,6 +56,7 @@ def _conf(n: int, s: int, fused_recv: bool, fused_gossip: bool,
         f"FAIL_TIME: {TICKS // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
+        f"FUSED_PROBE: {int(fused_probe)}\n"
         f"BACKEND: tpu_hash\n")
 
 
@@ -71,35 +72,41 @@ def _lower_for_tpu(params: Params) -> None:
                   lowering_platforms=("tpu",))
 
 
-# (name, n, s, fused_recv, fused_gossip, drops, folded) — the Pallas
-# variants of the hardware ladder (scripts/tpu_ladder.py) plus the
-# baseline; two sizes each so both _pick_block regimes elaborate.
+# (name, n, s, fused_recv, fused_gossip, fused_probe, drops, folded) —
+# the Pallas variants of the hardware ladder (scripts/tpu_ladder.py)
+# plus the baseline; two sizes each so both _pick_block regimes
+# elaborate.  The droppy fused rows exercise the masks-as-inputs gossip
+# kernels and the drop-composed receive/probe paths.
 VARIANTS = [
-    ("baseline",      4096, 128, False, False, True,  False),
-    ("frecv",         4096, 128, True,  False, True,  False),
-    ("frecv_small",    512, 128, True,  False, True,  False),
-    ("fgossip",       4096, 128, False, True,  False, False),
-    ("fgossip_small",  512, 128, False, True,  False, False),
-    ("fgossip_drops", 4096, 128, False, True,  True,  False),
-    ("fboth",         4096, 128, True,  True,  False, False),
-    ("folded_s16",    4096,  16, False, False, True,  False),
-    ("folded_fboth_s16", 4096, 16, True, True, True,  False),
-    ("folded_fboth_s32", 2048, 32, True, True, True,  False),
+    ("baseline",      4096, 128, False, False, False, True,  False),
+    ("frecv",         4096, 128, True,  False, False, True,  False),
+    ("frecv_small",    512, 128, True,  False, False, True,  False),
+    ("fgossip",       4096, 128, False, True,  False, False, False),
+    ("fgossip_small",  512, 128, False, True,  False, False, False),
+    ("fgossip_drops", 4096, 128, False, True,  False, True,  False),
+    ("fboth",         4096, 128, True,  True,  False, False, False),
+    ("fprobe",        4096, 128, False, False, True,  True,  False),
+    ("fall",          4096, 128, True,  True,  True,  True,  False),
+    ("folded_s16",    4096,  16, False, False, False, True,  False),
+    ("folded_fboth_s16", 4096, 16, True, True,  False, True,  False),
+    ("folded_fboth_s32", 2048, 32, True, True,  False, True,  False),
+    ("folded_fprobe_s16", 4096, 16, False, False, True, True, False),
+    ("folded_fall_s16", 4096, 16, True,  True,  True,  True,  False),
 ]
 # FOLDED is resolved by make_config (s < 128 + agg events + warm); the
 # `folded` flag in _conf pins it explicitly for the s=16/32 rows.
 VARIANTS = [
-    (name, n, s, fr, fg, dr, s < 128)
-    for (name, n, s, fr, fg, dr, _f) in VARIANTS
+    (name, n, s, fr, fg, fp, dr, s < 128)
+    for (name, n, s, fr, fg, fp, dr, _f) in VARIANTS
 ]
 
 
 @pytest.mark.quick
 @pytest.mark.parametrize(
-    "name,n,s,fr,fg,drops,folded",
+    "name,n,s,fr,fg,fp,drops,folded",
     VARIANTS, ids=[v[0] for v in VARIANTS])
-def test_full_scan_lowers_for_tpu(name, n, s, fr, fg, drops, folded):
-    _lower_for_tpu(_conf(n, s, fr, fg, drops, folded))
+def test_full_scan_lowers_for_tpu(name, n, s, fr, fg, fp, drops, folded):
+    _lower_for_tpu(_conf(n, s, fr, fg, drops, folded, fused_probe=fp))
 
 
 @pytest.mark.quick
