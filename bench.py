@@ -41,7 +41,10 @@ BENCH_TELEMETRY=1 re-times the leg with the flight recorder's in-scan
 per-tick scalars armed (TELEMETRY: scalars, observability/timeline.py),
 BENCH_HIST=1 the same with the histogram tier on top (TELEMETRY: hist —
 the in-graph bucketed one-hot reductions; its overhead row lands in
-PERF.md).
+PERF.md), BENCH_MEGA=T re-times the leg with the T-tick megakernel scan
+(MEGA_TICKS — ops/megakernel; carry resident across T inner ticks,
+shrunk at block boundaries) against the same per-tick chunked program,
+interleaved; banked as bench:live:hash:mega keyed per block size.
 
 Every live leg row is also banked into ``artifacts/perf_ledger.jsonl``
 (observability/perfdb.py) and checked against history; a regression
@@ -832,6 +835,46 @@ def leg_hash(n: int, ticks: int, pin: str | None,
                 100 * (walls["base"] - walls["fprobe"])
                 / max(walls["base"], 1e-9), 1),
         })
+    # BENCH_MEGA=T: price the T-tick megakernel scan (MEGA_TICKS —
+    # ops/megakernel.mega_scan: carry resident across T inner ticks,
+    # materialized to HBM only at T-block boundaries as the shrunk
+    # 16-bit/bit-packed carry) against the SAME per-tick chunked
+    # program.  Both arms run CHECKPOINT_EVERY = 4T segments so the
+    # comparison isolates the block restructuring, not chunking itself;
+    # interleaved best-of-R like the other few-percent legs.  Reported
+    # positive = the blocked scan is faster.  The carry-byte accounting
+    # (full vs shrunk boundary crossing) rides along for PERF.md.
+    try:
+        mega_t = int(os.environ.get("BENCH_MEGA", "0"))
+    except ValueError:
+        raise SystemExit("BENCH_MEGA must be an integer block size T in "
+                         "ticks (0 = off)")
+    if mega_t > 0:
+        from distributed_membership_tpu.ops.megakernel import carry_bytes
+
+        mega_ck = (f"CHECKPOINT_EVERY: {4 * mega_t}\n")
+
+        def _mega_params(t: int):
+            return Params.from_text(params_text + mega_ck
+                                    + f"MEGA_TICKS: {t}\n")
+
+        p_mg_off, p_mg_on = _mega_params(0), _mega_params(mega_t)
+        reps = int(os.environ.get("BENCH_MEGA_REPS", "3"))
+        mg_base_wall, _ = _timed_runs(run_scan, p_mg_off, plan, ticks)
+        walls = _interleaved_best(run_scan, ticks, (p_mg_off, plan),
+                                  {"mega": (p_mg_on, plan)}, reps,
+                                  mg_base_wall)
+        acct = carry_bytes(final_state, pack16=True)
+        ckpt_fields.update({
+            "mega_ticks": mega_t,
+            "mega_off_wall_seconds": round(walls["base"], 3),
+            "mega_wall_seconds": round(walls["mega"], 3),
+            "mega_speedup_pct": round(
+                100 * (walls["base"] - walls["mega"])
+                / max(walls["base"], 1e-9), 1),
+            "mega_carry_bytes_full": acct["full"],
+            "mega_carry_bytes_packed": acct["packed"],
+        })
     # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
     # (scenario/compile.py) at this leg's geometry, isolating the two
     # cost classes:
@@ -1137,6 +1180,29 @@ def _ledger_bank(leg: str, row: dict) -> None:
                 knobs={"unfused_wall_seconds":
                        row.get("fprobe_unfused_wall_seconds"),
                        "fused_wall_seconds": row.get("fprobe_wall_seconds"),
+                       "ticks": row.get("ticks")},
+                source="bench.py"))
+        if row.get("mega_ticks"):
+            # The BENCH_MEGA companion row: T-tick blocked scan vs the
+            # per-tick chunked program (positive = residency wins).
+            # knobs["mega_ticks"] makes perfdb key the rung per block
+            # size (rung:t{T}) — a T=8 trend never masks a T=32
+            # regression.
+            rows.append(perfdb.make_row(
+                f"bench:live:{leg}:mega",
+                metric="mega_speedup_pct",
+                value=row["mega_speedup_pct"], n=row.get("n"),
+                s=row.get("view_size"),
+                backend="tpu_hash" if leg == "hash" else "dense",
+                platform=row.get("platform"),
+                knobs={"mega_ticks": row["mega_ticks"],
+                       "off_wall_seconds":
+                       row.get("mega_off_wall_seconds"),
+                       "mega_wall_seconds": row.get("mega_wall_seconds"),
+                       "carry_bytes_full":
+                       row.get("mega_carry_bytes_full"),
+                       "carry_bytes_packed":
+                       row.get("mega_carry_bytes_packed"),
                        "ticks": row.get("ticks")},
                 source="bench.py"))
         perfdb.append_rows(rows, path)

@@ -100,6 +100,8 @@ from distributed_membership_tpu.ops.fused_probe import (
     probe_fused_supported, probe_window_fused)
 from distributed_membership_tpu.ops.fused_receive import (
     fused_supported, receive_core, receive_fused)
+from distributed_membership_tpu.ops.megakernel import (
+    PACK_SAFE_TICKS as _MEGA_PACK_SAFE, mega_scan, pack_fits)
 from distributed_membership_tpu.ops.rng_plan import RingRng, hash_ring_rng
 from distributed_membership_tpu.ops.sampling import sample_k_indices
 from distributed_membership_tpu.ops.view_merge import (
@@ -120,6 +122,11 @@ U32 = jnp.uint32
 # degradation is visible in the output, not just in PERF.md (VERDICT r2
 # weak-6/item-8).
 PROBE_IO_EXACT_MAX = 1 << 17
+# MEGA_TICKS auto candidates, largest first: the block sizes the ladder
+# runs hardware rungs for (1M_s16_mega{8,32}) and tpu_correctness banks
+# mega_t{T} families for — auto picks the biggest banked T that tiles
+# CHECKPOINT_EVERY (make_config; fail closed without chip evidence).
+MEGA_AUTO_TICKS = (32, 8)
 
 
 def probe_attribution_exact(params: Params) -> bool:
@@ -340,6 +347,19 @@ class HashConfig:
     #                              space with the exact unfused streams
     folded: bool = False         # [N/F, 128] folded physical layout for
     #                              S < 128 (backends/tpu_hash_folded.py)
+    mega_ticks: int = 0          # T >= 2: segment runners restructure
+    #                              the per-tick scan into T-tick blocks
+    #                              (ops/megakernel.mega_scan) — carry
+    #                              resident across the inner loop,
+    #                              materialized per block boundary only.
+    #                              0/1 = the plain per-tick scan (T=1 is
+    #                              op-count-identical by construction —
+    #                              tests/test_hlo_census.py)
+    mega_pack: bool = False      # shrink the T-block boundary carry:
+    #                              view_ts/self_hb as 16-bit lanes, bool
+    #                              planes bit-packed (megakernel codec;
+    #                              bit-exact under the static tick bound
+    #                              run_scan re-proves per run)
     send_budget: int = 0         # per-tick global send cap modeling
     #                              EmulNet's bounded buffer (EN_BUFFSIZE
     #                              drop-on-full, EmulNet.cpp:92-94);
@@ -1700,6 +1720,54 @@ def make_config(params: Params, collect_events: bool = True,
                 f"FUSED_PROBE needs VIEW_SIZE % 128 == 0, N >= 8 and "
                 f"0 < PROBES < VIEW_SIZE (got N={n}, S={s}, "
                 f"P={params.PROBES}); for S < 128 combine it with FOLDED")
+    # --- multi-tick residency (MEGA_TICKS / MEGA_PACK) ------------------
+    # Params.validate already enforced the cheap invariants (backend
+    # family, CHECKPOINT_EVERY > 0, K % T == 0); here the resolved
+    # exchange gates the pinned knob loudly and auto resolves against
+    # the banked per-T hardware families, mirroring the FUSED_* block.
+    mega_knob = params.MEGA_TICKS
+    if mega_knob == -1:
+        mega_knob = 0
+        from distributed_membership_tpu.runtime.fusegate import (
+            banked_correctness, families_clean, on_tpu)
+        pre_m = {"tpu_hash": "", "tpu_hash_sharded": "sharded_"}.get(
+            params.BACKEND)
+        if (on_tpu() and pre_m is not None and exchange == "ring"
+                and params.CHECKPOINT_EVERY > 0):
+            rec_m = banked_correctness()
+            for t in MEGA_AUTO_TICKS:
+                # Largest banked block size that tiles the segment wins;
+                # a chip without a mega_t{T} verdict keeps the per-tick
+                # scan (fail closed, auto never raises).
+                if (params.CHECKPOINT_EVERY % t == 0
+                        and families_clean(rec_m, f"{pre_m}mega_t{t}")):
+                    mega_knob = t
+                    break
+    mega = int(mega_knob)
+    if mega > 0 and exchange != "ring":
+        raise ValueError(
+            "MEGA_TICKS requires the ring exchange (the scatter "
+            "lowering keeps the per-tick scan)")
+    mp_knob = params.MEGA_PACK
+    if mp_knob == -1:
+        # Auto packs exactly when the static 16-bit bound is proven for
+        # the declared run length; run_scan re-proves it against the
+        # effective total (a longer total_time override widens an auto
+        # pack silently, raises on a pinned one).
+        mp_knob = int(mega > 1 and pack_fits(params.TOTAL_TIME))
+    elif mp_knob == 1:
+        if mega <= 1:
+            raise ValueError(
+                "MEGA_PACK: 1 requires MEGA_TICKS >= 2 (resolved "
+                f"T={mega}: no T-block boundary exists to shrink)")
+        if not pack_fits(params.TOTAL_TIME):
+            raise ValueError(
+                f"MEGA_PACK: 1 cannot prove the 16-bit carry bound for "
+                f"TOTAL_TIME={params.TOTAL_TIME} (heartbeats/timestamps "
+                f"must stay under 2**16 after the +1 sentinel offset: "
+                f"at most {_MEGA_PACK_SAFE} ticks — "
+                "ops/megakernel.PACK_SAFE_TICKS); use MEGA_PACK 0 or "
+                "-1 (auto widens to the full-width carry)")
     if params.SHIFT_SET:
         # Loud-rejection policy (same as PROBE_IO approx_lag): off-path
         # layouts must not silently ignore the knob.
@@ -1760,6 +1828,7 @@ def make_config(params: Params, collect_events: bool = True,
         probe_io_lag=params.PROBE_IO == "approx_lag",
         fused_receive=fused, fused_gossip=fused_g, fused_probe=fused_p,
         folded=folded,
+        mega_ticks=mega, mega_pack=bool(mp_knob),
         send_budget=send_budget, shift_set=params.SHIFT_SET,
         # Normalized so configs whose lowering cannot differ share one
         # compiled runner: non-ring paths keep site-local draws
@@ -1774,6 +1843,26 @@ def make_config(params: Params, collect_events: bool = True,
         telemetry=params.TELEMETRY in ("scalars", "hist"),
         telemetry_hist=params.TELEMETRY == "hist",
         scenario=scenario)
+
+
+def resolve_mega_pack(cfg: HashConfig, params: Params,
+                      total: int) -> HashConfig:
+    """Re-prove the shrunk-carry bound against the EFFECTIVE run length
+    (run_scan's ``total_time`` override can exceed the TOTAL_TIME that
+    make_config proved the bound for).  Auto widens silently to the
+    full-width carry; a pinned ``MEGA_PACK: 1`` raises.  This host-side
+    static variant selection IS the codec's overflow widening: the
+    packed and wide programs are separate compiled runners (cfg is the
+    cache key), chosen by the proven tick bound, both bit-exact."""
+    if not cfg.mega_pack or pack_fits(total):
+        return cfg
+    if params.MEGA_PACK == 1:
+        raise ValueError(
+            f"MEGA_PACK: 1 cannot prove the 16-bit carry bound for the "
+            f"effective run length {total} (at most {_MEGA_PACK_SAFE} "
+            "ticks — ops/megakernel.PACK_SAFE_TICKS); use MEGA_PACK 0 "
+            "or -1 (auto widens to the full-width carry)")
+    return dataclasses.replace(cfg, mega_pack=False)
 
 
 _RUNNER_CACHE: dict = {}
@@ -1891,6 +1980,13 @@ def _get_segment_runner(cfg: HashConfig, warm: bool):
                 return step(state, (t, k, start_ticks, fail_mask,
                                     fail_time, drop_lo, drop_hi) + extra)
 
+            # MEGA_TICKS >= 2 restructures the segment into T-tick
+            # blocks (carry resident across the inner loop, shrunk at
+            # block boundaries under mega_pack); T <= 1 IS the plain
+            # scan below — op-count identical (ops/megakernel.py).
+            if cfg.mega_ticks > 1:
+                return mega_scan(body, state, xs, cfg.mega_ticks,
+                                 cfg.mega_pack)
             return jax.lax.scan(body, state, xs)
 
         _RUNNER_CACHE[cache_key] = jax.jit(run_seg)
@@ -1925,6 +2021,7 @@ def run_scan(params: Params, plan: FailurePlan, seed: int,
     total = total_time if total_time is not None else params.TOTAL_TIME
     # Same effective-run-length packing guard as tpu_sparse.run_scan.
     params.validate_sparse_packing(total)
+    cfg = resolve_mega_pack(cfg, params, total)
     warm = params.JOIN_MODE == "warm"
 
     if params.CHECKPOINT_EVERY > 0:

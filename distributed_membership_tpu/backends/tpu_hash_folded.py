@@ -31,6 +31,17 @@ warm join, aggregate events with the FastAgg path, ``128 % S == 0``,
 ``N % F == 0``, and ``128 % P == 0`` when probing.  Cold joins, full
 event collection, and the scatter exchange keep the natural layout.
 
+**Multi-tick residency.**  The folded step composes with ``MEGA_TICKS``
+unchanged: the T-block segment runner (ops/megakernel.mega_scan) wraps
+whatever step _get_step_and_init returns, and the shrunk-carry codec
+classifies leaves by FIELD NAME and dtype — the folded HashState keeps
+the natural field names (``view_ts`` is the same i32 payload reshaped
+to ``[N*S/128, 128]``, ``self_hb`` stays ``[N]``), so the 16-bit lane
+pack and the bool bitplanes apply to the folded carry with no
+layout-specific code.  Bit-exactness of the folded mega scan vs the
+folded per-tick scan is pinned alongside the natural twins
+(tests/test_megakernel.py).
+
 Reference lineage: the step semantics are tpu_hash's, which replicate
 /root/reference/MP1Node.cpp:404-495 (nodeLoopOps) + EmulNet delivery —
 see the tpu_hash module docstring for the mapping.

@@ -69,7 +69,8 @@ from distributed_membership_tpu.backends.tpu_hash import (
     STRIDE, HashConfig, I32, U32, _credit_orphan_recvs_sharded,
     _gathered_act, _gathered_flush, _gathered_hb, _pack_probe_bits,
     _pack_probe_table, ptr_switch, _will_flush, make_admit, make_config,
-    pack, slot_of, unpack)
+    pack, resolve_mega_pack, slot_of, unpack)
+from distributed_membership_tpu.ops.megakernel import mega_scan
 from distributed_membership_tpu.backends.tpu_sparse import (
     SparseTickEvents, finish_run)
 from distributed_membership_tpu.config import Params
@@ -1501,7 +1502,17 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
                 return step(state, (t, k, start_ticks, fail_mask_g,
                                     fail_time, drop_lo, drop_hi) + extra)
 
-            final_state, out = lax.scan(body, state, (ticks, keys))
+            # MEGA_TICKS >= 2: T-tick blocks inside the shard_map — the
+            # codec and block restitching are elementwise/reshape-only
+            # on the per-shard leaves (no collectives), so the mega
+            # wrapper slots between the agg re-init above and the agg
+            # reduction below without touching either.
+            if cfg.mega_ticks > 1:
+                final_state, out = mega_scan(
+                    body, state, (ticks, keys), cfg.mega_ticks,
+                    cfg.mega_pack)
+            else:
+                final_state, out = lax.scan(body, state, (ticks, keys))
             if not cfg.collect_events:
                 final_state = final_state._replace(
                     agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
@@ -1622,6 +1633,7 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
     scn_extra = () if scn_prog is None else (scn_prog.tensors(),)
     total = total_time if total_time is not None else params.TOTAL_TIME
     params.validate_sparse_packing(total)
+    cfg = resolve_mega_pack(cfg, params, total)
     warm = params.JOIN_MODE == "warm"
 
     if params.CHECKPOINT_EVERY > 0:

@@ -136,6 +136,29 @@ class Params:
     # with the natural layout (same seed -> same trajectory).
     # 1/0/-1 as FUSED_RECEIVE (auto gated on banked chip evidence).
     FOLDED: int = -1
+    # Multi-tick residency (ops/megakernel.py): fuse T protocol ticks
+    # per outer scan iteration — the carry stays device-resident across
+    # an inner T-tick loop and materializes at block boundaries only,
+    # which CHECKPOINT_EVERY already defines (T must tile K; the run's
+    # tail segment shorter than T runs a smaller block).  Requires the
+    # ring exchange on tpu_hash/tpu_hash_sharded and CHECKPOINT_EVERY
+    # > 0.  Bit-exact with the per-tick scan (same step function, same
+    # operand stream — tests/test_megakernel.py); T=1 is op-count
+    # identical to the plain program (tests/test_hlo_census.py).
+    # T >= 2 = on, 0 = off, -1 = auto: on IFF the process resolved to a
+    # real TPU, the config structurally supports it, AND the chip has a
+    # banked bit-exactness verdict for the mega_t{T} family
+    # (runtime/fusegate.py — fail closed, like FUSED_PROBE).
+    MEGA_TICKS: int = -1
+    # Shrunk T-block carry (ops/megakernel.py codec): timestamp planes
+    # (view_ts/self_hb) cross block boundaries as 16-bit lanes packed
+    # two-per-u32 and bool planes bit-packed 32-per-u32, cutting the
+    # HBM bytes per boundary.  Bit-exact iff the run's effective tick
+    # count fits the 16-bit bound (megakernel.PACK_SAFE_TICKS); the
+    # check is static and host-side — 1 = on (an unprovable bound
+    # raises), 0 = wide carry, -1 = auto (packs when the bound fits,
+    # silently widens otherwise; auto never raises).  Needs MEGA_TICKS.
+    MEGA_PACK: int = -1
     # Device-mesh shape for the sharded backends: '' = auto (largest
     # 1-D mesh dividing the node count), 'D' = 1-D over D devices,
     # 'OxI' = 2-D torus, 'SxOxI' = 3-D multi-slice torus (outermost
@@ -537,6 +560,41 @@ class Params:
                 raise ValueError(
                     f"{knob} must be 1 (on), 0 (off) or -1 (auto), got "
                     f"{getattr(self, knob)!r}")
+        if self.MEGA_TICKS < -1:
+            raise ValueError(
+                f"MEGA_TICKS must be -1 (auto), 0 (off) or a positive "
+                f"ticks-per-block T, got {self.MEGA_TICKS!r}")
+        if self.MEGA_TICKS > 0:
+            # Loud-rejection policy (as TELEMETRY / RNG_MODE hoisted):
+            # only the ring-family scan runners implement the T-block
+            # restructuring — silently accepting the knob elsewhere
+            # would time/checkpoint a program that never blocked.
+            if self.BACKEND not in ("tpu_hash", "tpu_hash_sharded"):
+                raise ValueError(
+                    "MEGA_TICKS is implemented by the ring backends "
+                    "only (tpu_hash, tpu_hash_sharded; got BACKEND "
+                    f"{self.BACKEND!r})")
+            if self.CHECKPOINT_EVERY <= 0:
+                raise ValueError(
+                    "MEGA_TICKS requires CHECKPOINT_EVERY > 0 (T-tick "
+                    "blocks tile the chunked segments; the monolithic "
+                    "scan has no block boundary to align to — "
+                    "runtime/checkpoint.py)")
+            if self.CHECKPOINT_EVERY % self.MEGA_TICKS != 0:
+                raise ValueError(
+                    f"MEGA_TICKS ({self.MEGA_TICKS}) must tile "
+                    f"CHECKPOINT_EVERY ({self.CHECKPOINT_EVERY}): "
+                    "K % T == 0, so block boundaries and segment "
+                    "boundaries coincide (only the run's final tail "
+                    "segment may be shorter than T)")
+        if self.MEGA_PACK not in (-1, 0, 1):
+            raise ValueError(
+                f"MEGA_PACK must be 1 (on), 0 (off) or -1 (auto), got "
+                f"{self.MEGA_PACK!r}")
+        if self.MEGA_PACK == 1 and self.MEGA_TICKS == 0:
+            raise ValueError(
+                "MEGA_PACK: 1 requires MEGA_TICKS (the shrunk carry "
+                "exists only at T-block boundaries)")
         if self.MESH_SHAPE:
             parts = self.MESH_SHAPE.lower().split("x")
             if not (1 <= len(parts) <= 3

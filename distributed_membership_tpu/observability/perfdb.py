@@ -59,6 +59,14 @@ def make_row(rung: str, *, metric: str, value: float,
              timestamp: Optional[str] = None,
              higher_is_better: bool = True) -> dict:
     knobs = dict(knobs or {})
+    # Multi-tick-residency rows key per BLOCK SIZE: a truthy
+    # knobs["mega_ticks"] lifts T into the rung itself (rung:t{T}), so
+    # --check trends T=8 and T=32 separately — the knobs digest alone
+    # would also separate them, but only the rung is human-readable in
+    # the regression report, and a T=8 trend must never mask a T=32
+    # regression behind an opaque digest.
+    if knobs.get("mega_ticks"):
+        rung = f"{rung}:t{int(knobs['mega_ticks'])}"
     digest = knobs_digest(knobs)
     key = "|".join([rung, str(n), str(s), str(backend), str(platform),
                     metric, digest])

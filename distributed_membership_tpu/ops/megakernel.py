@@ -1,0 +1,239 @@
+"""Multi-tick residency: the T-tick megakernel scan and the shrunk carry.
+
+``MEGA_TICKS: T`` (config.py) fuses T protocol ticks per outer scan
+iteration: the outer ``lax.scan`` steps over ``[nblk, T, ...]`` blocks of
+the per-tick operands (tick indices, RNG keys or hoisted RNG plans) and
+each block runs the SAME per-tick step function in an inner ``lax.scan``
+whose carry never leaves the device between ticks — XLA keeps the inner
+loop's live state resident (VMEM where it fits, without a round trip
+through the scan-boundary copy machinery either way), so the per-tick
+scan overhead and the carry materialization amortize over T.  The carry
+crosses the outer scan boundary only once per T-block — exactly the
+boundary ``CHECKPOINT_EVERY`` already defines (T tiles the segment;
+backends/tpu_hash.make_config validates), so checkpoint/resume, the
+service boundary hook, and ``EVENT_MODE: full`` flushes keep their
+existing semantics unchanged.
+
+``T <= 1`` (and segments shorter than one block) bypass the block
+machinery entirely and run the plain per-tick ``lax.scan`` — the
+``MEGA_TICKS: 1`` program is the PR-8 fused program BY CONSTRUCTION,
+which tests/test_hlo_census.py pins op-count-identical.  A tail segment
+whose length is not a multiple of T runs its ``L % T`` remainder as a
+plain scan after the blocks (a smaller block, same step stream).
+
+The **shrunk carry** (``MEGA_PACK``) cuts the bytes that cross each
+T-block boundary: the timestamp planes (``view_ts`` [N, S] i32 and
+``self_hb`` [N] i32 — values bounded by the run's tick count, plus the
+-1/"never" sentinels) are packed two-per-u32 as 16-bit lanes with a +1
+offset, and every bool plane (liveness/suspicion/handshake masks) is
+bit-packed 32-per-u32.  Reconstruction is bit-exact whenever the 16-bit
+bound holds (:func:`pack_fits`); the bound is STATIC — heartbeats
+advance +2/tick from 1 and timestamps are tick values, so the proven
+bound is the run's effective total tick count, checked host-side at
+``make_config``/``run_scan`` time.  Overflow "widening" is therefore a
+static variant selection: an auto (``-1``) pack silently downgrades to
+the wide carry when the bound does not fit; a pinned ``MEGA_PACK: 1``
+raises loudly (auto never raises — the FUSED_* contract).  The ``view``
+plane (u32 ``hb * N + id + 1``) is NOT packable — its payload spans the
+full 32 bits at any interesting N — and the mailboxes are transient
+u32 payloads; both stay wide.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+# Largest effective run length (in ticks) whose timestamp/heartbeat
+# values provably fit the 16-bit packed lanes: heartbeats advance +2 per
+# tick from 1 (reference double increment) and view_ts holds tick
+# values, so every packable value is <= 2*total + 1; with the +1
+# sentinel offset the packed lane needs 2*total + 2 < 2**16.  A small
+# margin keeps the bound conservative against off-by-a-few evolutions.
+PACK_SAFE_TICKS = (1 << 15) - 16
+
+# i32 state fields whose values are tick/heartbeat-bounded and may carry
+# as 16-bit lanes.  Keyed by FIELD NAME so the natural, folded (reshaped
+# planes, same names) and sharded (same names minus wf_prev) twins all
+# route through one codec with no per-layout special cases.
+_TS16_FIELDS = frozenset({"view_ts", "self_hb"})
+
+
+def pack_fits(total_ticks: int) -> bool:
+    """Does the 16-bit packed carry provably cover a run of this many
+    effective ticks?  (Static host-side check — see module docstring.)"""
+    return 0 <= int(total_ticks) <= PACK_SAFE_TICKS
+
+
+def fits16(x) -> bool:
+    """Dynamic twin of :func:`pack_fits` for tests: do these values
+    actually survive the u16+1 round trip?  (The production path never
+    needs this — the static bound decides the variant.)"""
+    import numpy as np
+
+    a = np.asarray(x).astype(np.int64)
+    return bool(((a + 1 >= 0) & (a + 1 < (1 << 16))).all())
+
+
+def _leaf_name(path) -> str:
+    """Last attribute name on a tree path ('' when unnamed)."""
+    for entry in reversed(path):
+        name = getattr(entry, "name", None)
+        if name is not None:
+            return str(name)
+    return ""
+
+
+def _pack_bits(a):
+    """[...] bool -> ([ceil(size/32)] u32 words, static spec)."""
+    flat = a.reshape(-1)
+    m = flat.shape[0]
+    pad = (-m) % 32
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), dtype=jnp.bool_)])
+    lanes = flat.reshape(-1, 32).astype(U32)
+    shifts = jnp.arange(32, dtype=U32)[None, :]
+    return jnp.sum(lanes << shifts, axis=1, dtype=U32)
+
+
+def _unpack_bits(words, shape):
+    size = 1
+    for d in shape:
+        size *= d
+    shifts = jnp.arange(32, dtype=U32)[None, :]
+    bits = (words[:, None] >> shifts) & U32(1)
+    return bits.reshape(-1)[:size].astype(jnp.bool_).reshape(shape)
+
+
+def _pack_u16(a):
+    """[..., d] i32 in [-1, 2**16 - 2] -> [..., ceil(d/2)] u32 lanes."""
+    u = (a + 1).astype(U32)
+    d = u.shape[-1]
+    if d % 2:
+        u = jnp.concatenate(
+            [u, jnp.zeros(u.shape[:-1] + (1,), dtype=U32)], axis=-1)
+    pair = u.reshape(u.shape[:-1] + (-1, 2))
+    return pair[..., 0] | (pair[..., 1] << U32(16))
+
+
+def _unpack_u16(words, shape):
+    lo = words & U32(0xFFFF)
+    hi = words >> U32(16)
+    u = jnp.stack([lo, hi], axis=-1).reshape(words.shape[:-1] + (-1,))
+    return u[..., :shape[-1]].astype(I32) - 1
+
+
+def make_codec(state, pack16: bool):
+    """(pack, unpack) for a carry pytree: bool leaves bit-packed
+    32-per-u32 (always exact), :data:`_TS16_FIELDS` i32 leaves packed as
+    16-bit pairs when ``pack16`` (exact under the static tick bound —
+    module docstring), everything else identity.  Classification uses
+    only static leaf metadata (field name, dtype, shape), so the codec
+    builds the same way from live tracers inside a jit/shard_map trace
+    as from host arrays.
+    """
+    leaves, treedef = tree_flatten_with_path(state)
+    plan = []
+    for path, leaf in leaves:
+        name = _leaf_name(path)
+        shape = tuple(leaf.shape)
+        if leaf.dtype == jnp.bool_:
+            plan.append(("bits", shape))
+        elif pack16 and name in _TS16_FIELDS and leaf.dtype == I32:
+            plan.append(("u16", shape))
+        else:
+            plan.append(("raw", shape))
+
+    def pack(st):
+        out = []
+        for (kind, _), (_, leaf) in zip(plan,
+                                        tree_flatten_with_path(st)[0]):
+            if kind == "bits":
+                out.append(_pack_bits(leaf))
+            elif kind == "u16":
+                out.append(_pack_u16(leaf))
+            else:
+                out.append(leaf)
+        return tuple(out)
+
+    def unpack(packed):
+        out = []
+        for (kind, shape), leaf in zip(plan, packed):
+            if kind == "bits":
+                out.append(_unpack_bits(leaf, shape))
+            elif kind == "u16":
+                out.append(_unpack_u16(leaf, shape))
+            else:
+                out.append(leaf)
+        return tree_unflatten(treedef, out)
+
+    return pack, unpack
+
+
+def carry_bytes(state, pack16: bool = True) -> dict:
+    """Boundary-crossing byte accounting for PERF.md / the bench row:
+    ``full`` is the wide carry, ``packed`` what the shrunk carry moves
+    per T-block boundary under this codec.  Works on arrays or
+    ShapeDtypeStructs (an ``eval_shape`` carry costs nothing)."""
+    leaves, _ = tree_flatten_with_path(state)
+    full = packed = 0
+    for path, leaf in leaves:
+        size = 1
+        for d in leaf.shape:
+            size *= d
+        nbytes = size * jnp.dtype(leaf.dtype).itemsize
+        full += nbytes
+        if leaf.dtype == jnp.bool_:
+            packed += 4 * (-(-size // 32))
+        elif pack16 and _leaf_name(path) in _TS16_FIELDS \
+                and leaf.dtype == I32:
+            last = leaf.shape[-1] if leaf.shape else 1
+            packed += nbytes // last * (-(-last // 2))
+        else:
+            packed += nbytes
+    return {"full": int(full), "packed": int(packed)}
+
+
+def mega_scan(body, state, xs, t_block: int, pack16: bool = False):
+    """``lax.scan(body, state, xs)`` restructured into T-tick blocks.
+
+    Drop-in replacement for the segment runners' per-tick scan: same
+    (carry, ys) contract, bit-identical trajectory and outputs.  The
+    leading axis L of ``xs`` splits into ``L // T`` blocks driven by an
+    outer scan whose carry is the (optionally shrunk — ``pack16``)
+    packed carry, plus an ``L % T`` plain-scan tail; ys leaves are
+    emitted per inner tick and restitched to the flat ``[L, ...]`` shape
+    the chunked driver and telemetry sinks already consume.
+
+    ``t_block <= 1`` or ``L <= T`` returns the plain scan unchanged —
+    the op-count-identity anchor the census pins.
+    """
+    length = jax.tree_util.tree_leaves(xs)[0].shape[0]
+    t = int(t_block)
+    if t <= 1 or length <= t:
+        return jax.lax.scan(body, state, xs)
+    nblk, tail = divmod(length, t)
+
+    head = jax.tree.map(
+        lambda a: a[:nblk * t].reshape((nblk, t) + a.shape[1:]), xs)
+    pack, unpack = make_codec(state, pack16)
+
+    def block(packed, xs_blk):
+        st, ys = jax.lax.scan(body, unpack(packed), xs_blk)
+        return pack(st), ys
+
+    packed, ys_blocks = jax.lax.scan(block, pack(state), head)
+    state = unpack(packed)
+    ys = jax.tree.map(
+        lambda a: a.reshape((nblk * t,) + a.shape[2:]), ys_blocks)
+    if tail:
+        xs_tail = jax.tree.map(lambda a: a[nblk * t:], xs)
+        state, ys_tail = jax.lax.scan(body, state, xs_tail)
+        ys = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), ys, ys_tail)
+    return state, ys

@@ -61,6 +61,12 @@ STATE_FILE_ENV = "DM_RUN_STATE_FILE"
 _IDENTITY_EXCLUDE = frozenset(
     {"globaltime", "dropmsg", "CHECKPOINT_EVERY", "CHECKPOINT_DIR",
      "RESUME", "CHECKPOINT_COMPRESS",
+     # Multi-tick residency is trajectory-inert by contract: the T-tick
+     # megakernel blocks and the shrunk boundary carry are bit-exact vs
+     # the per-tick scan (tests/test_megakernel.py pins all four ring
+     # twins), so a resume may change T or the pack width — the on-disk
+     # snapshot is always the full-width carry at a segment boundary.
+     "MEGA_TICKS", "MEGA_PACK",
      # Telemetry is trajectory-inert by contract (tests/test_timeline.py
      # pins bit-exactness on/off), so a resume may turn the flight
      # recorder on or move its output dir without invalidating the run.

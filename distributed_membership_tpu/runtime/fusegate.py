@@ -23,7 +23,11 @@ The family keys mirror tpu_correctness.py's ``mismatched_elements``:
 ``fused_gossip_drops`` (the masks-as-inputs kernels on lossy/flaky
 configs), ``fused_probe`` (the fused probe/agg traversal),
 ``folded_s{S}``, ``folded_fused_s{S}``,
-``folded_fused_probe_s{S}``, and their ``sharded_`` twins.
+``folded_fused_probe_s{S}``, ``mega_t{T}`` (the T-tick megakernel scan
+with the shrunk boundary carry, one family PER BLOCK SIZE — a chip that
+proved T=8 has proved nothing about T=32; tpu_hash.MEGA_AUTO_TICKS
+lists the block sizes the correctness arms bank), and their
+``sharded_`` twins.
 A missing record, a non-tpu record, or a family
 absent from the record (e.g. a fold factor the correctness N could not
 fold) all read as NOT cleared — fail closed.

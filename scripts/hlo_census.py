@@ -40,7 +40,8 @@ if REPO not in sys.path:
 def census_params(n: int, s: int, *, rng_mode: str = "batched",
                   probe_gather: str = "packed", drops: bool = False,
                   probe_io: str = "auto", telemetry: str = "off",
-                  fused: bool = False, folded: bool | None = None):
+                  fused: bool = False, folded: bool | None = None,
+                  mega: int = 0, ck_every: int = 0):
     """The ladder's 1M_s16 step config (profile_step.py defaults) at
     (n, s), with the round-6 lowering knobs exposed.  ``drops`` arms the
     msgdrop-class coin streams — the regime where the batched plan
@@ -59,13 +60,19 @@ def census_params(n: int, s: int, *, rng_mode: str = "batched",
                  else "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
     f = int(fused)
     fold = f if folded is None else int(folded)
+    # ck_every > 0 chunks the run so the segment-runner census (the
+    # program MEGA_TICKS restructures) is traceable; MEGA_TICKS is then
+    # pinned explicitly — never left on auto — so the traced program is
+    # platform-independent.
+    mega_keys = (f"CHECKPOINT_EVERY: {ck_every}\nMEGA_TICKS: {mega}\n"
+                 if ck_every > 0 else "")
     return Params.from_text(
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\nFANOUT: 3\n"
         f"TFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
         f"JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
         f"FUSED_RECEIVE: {f}\nFUSED_GOSSIP: {f}\nFOLDED: {fold}\n"
-        f"FUSED_PROBE: {f}\n"
+        f"FUSED_PROBE: {f}\n{mega_keys}"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
         f"PROBE_IO: {probe_io}\nTELEMETRY: {telemetry}\n"
         f"BACKEND: tpu_hash\n")
@@ -133,8 +140,12 @@ def step_census(params, scenario=None) -> dict:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             scenario.tensors()),)
     traced = jax.jit(lambda st, inp: step(st, inp)).trace(state, inp)
+    return _count_program(traced.jaxpr.jaxpr, n, params.VIEW_SIZE)
 
-    s = params.VIEW_SIZE
+
+def _count_program(jaxpr, n: int, s: int) -> dict:
+    """Count the flagged op classes over a traced program (shared by the
+    per-tick step census and the segment-runner census)."""
     counts = {"threefry_calls": 0, "big_gathers": 0,
               "big_gather_shapes": [], "big_scatters": 0,
               "total_eqns": 0, "ns_class_ops": 0, "pallas_calls": 0}
@@ -172,10 +183,68 @@ def step_census(params, scenario=None) -> dict:
             if out_size >= n:
                 counts["big_scatters"] += 1
 
-    _walk_eqns(traced.jaxpr.jaxpr, visit)
+    _walk_eqns(jaxpr, visit)
     counts["n"] = n
     counts["s"] = s
     return counts
+
+
+def segment_census(params) -> dict:
+    """Trace the CHUNKED segment-runner program (``CHECKPOINT_EVERY``
+    ticks per call — the program ``MEGA_TICKS`` restructures into
+    T-tick blocks, backends/tpu_hash._get_segment_runner) and count the
+    same op classes as :func:`step_census`.  ``_walk_eqns`` counts a
+    scan BODY's eqns once regardless of trip count, so the census is
+    per-PROGRAM: a mega block that re-launched the kernels per unrolled
+    tick would show ``3*T`` pallas_calls, the resident inner-loop
+    program shows 3 — the "(not 3·T)" budget the mega tests pin."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.backends.tpu_hash import (
+        _get_segment_runner, _get_step_and_init, make_config)
+
+    n = params.EN_GPSZ
+    k = params.CHECKPOINT_EVERY
+    assert k > 0, "segment_census needs a chunked config"
+    cfg = make_config(params, collect_events=False, fail_ids=(0,))
+    _, init = _get_step_and_init(cfg, warm=True)
+    runner = _get_segment_runner(cfg, warm=True)
+    key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    state = jax.eval_shape(init, key_sds)
+    i32 = jnp.int32
+    traced = runner.trace(
+        state,
+        jax.ShapeDtypeStruct((k,), i32),
+        jax.ShapeDtypeStruct((k, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((n,), i32),
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.ShapeDtypeStruct((), i32),
+        jax.ShapeDtypeStruct((), i32),
+        jax.ShapeDtypeStruct((), i32))
+    return _count_program(traced.jaxpr.jaxpr, n, params.VIEW_SIZE)
+
+
+def mega_census(n: int = 1 << 20, s: int = 16, t: int = 8) -> dict:
+    """The multi-tick-residency structural contract at (n, s): three
+    segment-runner programs over a K = 2T-tick segment of the
+    fully-fused droppy step — ``plain`` (MEGA_TICKS: 0, the PR-8
+    per-tick scan), ``mega_t1`` (MEGA_TICKS: 1 — pinned op-count
+    IDENTICAL to plain: T <= 1 bypasses the block machinery entirely),
+    and ``mega`` (the T-block program with the shrunk boundary carry).
+    tests/test_hlo_census.py pins the budget: Pallas calls stay at the
+    PR-8 count of 3 per block program (NOT 3·T — the inner loop is a
+    scan, not an unroll), zero new [N]-class gathers/scatters, and the
+    codec's pack/unpack adds only a bounded handful of elementwise
+    [N, S]-class ops."""
+    k = 2 * t
+
+    def arm(mega):
+        return segment_census(census_params(
+            n, s, drops=True, fused=True, mega=mega, ck_every=k))
+
+    return {"n": n, "s": s, "t": t, "k": k,
+            "plain": arm(0), "mega_t1": arm(1), "mega": arm(t)}
 
 
 def full_census(n: int = 1 << 20, s: int = 16) -> dict:
@@ -256,6 +325,14 @@ def main() -> int:
                     help="print the whole-tick-fusion census (unfused vs "
                          "fully-fused droppy step) instead; with --check, "
                          "assert the fused pass-count budget")
+    ap.add_argument("--mega", type=int, default=0, metavar="T",
+                    help="print the multi-tick-residency census (the "
+                         "segment program at MEGA_TICKS 0 vs 1 vs T) "
+                         "instead; with --check, assert the per-T-block "
+                         "budget: Pallas calls <= 3 + O(1) (not 3*T), "
+                         "zero new [N]-class gathers/scatters, and "
+                         "MEGA_TICKS=1 op-count-identical to the plain "
+                         "program")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero unless the default program shows "
                          "exactly one probe-leg gather and fewer "
@@ -263,6 +340,27 @@ def main() -> int:
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    if args.mega:
+        out = mega_census(args.n, args.view, args.mega)
+        print(json.dumps(out))
+        if args.check:
+            pl, m1, mg = out["plain"], out["mega_t1"], out["mega"]
+            ok = (m1 == pl
+                  and mg["pallas_calls"] <= pl["pallas_calls"] + 1
+                  and mg["big_gathers"] <= pl["big_gathers"]
+                  and mg["big_scatters"] <= pl["big_scatters"]
+                  and mg["threefry_calls"] <= pl["threefry_calls"]
+                  and mg["ns_class_ops"] <= pl["ns_class_ops"] + 32)
+            if not ok:
+                print("mega census regression: the T-block segment "
+                      "program must keep the per-block Pallas-call "
+                      "count at the PR-8 budget (3 + O(1), not 3*T), "
+                      "add no [N]-class gathers/scatters or threefry "
+                      "draws, and MEGA_TICKS=1 must be op-count-"
+                      "identical to the plain segment program",
+                      file=sys.stderr)
+                return 1
+        return 0
     if args.scenario:
         print(json.dumps(scenario_census(args.n, args.view)))
         return 0

@@ -42,6 +42,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                rng_mode: str = "batched",
                probe_gather: str = "packed",
                fused_probe: bool = False, drops: bool = False,
+               mega_ticks: int = 0,
                trace_dir: str = "", runlog=None) -> dict:
     import random as _pyrandom
 
@@ -87,6 +88,13 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     ck_every = int(os.environ.get("DM_CHECKPOINT_EVERY", "0") or 0)
     ck_dir = os.environ.get("DM_CHECKPOINT_DIR", "")
     resume = os.environ.get("DM_RESUME", "") not in ("", "0")
+    # --mega-ticks T (MEGA_TICKS — ops/megakernel): the T-tick blocked
+    # scan needs chunked segments that T tiles, so an unset (or
+    # non-tiling) DM_CHECKPOINT_EVERY defaults to 4 blocks per segment
+    # rather than rejecting the rung.
+    if mega_ticks > 0 and (ck_every <= 0 or ck_every % mega_ticks != 0):
+        ck_every = 4 * mega_ticks
+    mega_text = f"MEGA_TICKS: {mega_ticks}\n" if mega_ticks > 0 else ""
     resumed_from = None
     warm_params = timed_params = params
     ckpt_fields = {}
@@ -96,13 +104,16 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         do_resume = int(resume and bool(ck_dir))
         warm_params = Params.from_text(
             text + f"CHECKPOINT_EVERY: {ck_every}\n"
-            f"CHECKPOINT_DIR: {ck_dir}\nRESUME: {do_resume}\n")
+            f"CHECKPOINT_DIR: {ck_dir}\nRESUME: {do_resume}\n"
+            + mega_text)
         timed_params = Params.from_text(
-            text + f"CHECKPOINT_EVERY: {ck_every}\n")
+            text + f"CHECKPOINT_EVERY: {ck_every}\n" + mega_text)
         if do_resume:
             resumed_from = manifest_tick(ck_dir)
         ckpt_fields = {"checkpoint_every": ck_every,
                        "resumed_from_tick": resumed_from}
+    if mega_ticks > 0:
+        ckpt_fields["mega_ticks"] = mega_ticks
 
     point = {"n": n, "s": s, "ticks": ticks, "exchange": exchange}
     if runlog is not None:
@@ -253,6 +264,12 @@ def main() -> int:
                          "+ agg + hist Pallas kernel (ops/fused_probe; "
                          "needs ring + S %% 128 == 0, or FOLDED for "
                          "S < 128)")
+    ap.add_argument("--mega-ticks", type=int, default=0,
+                    help="MEGA_TICKS: T-tick megakernel scan "
+                         "(ops/megakernel; 0 = off).  Defaults "
+                         "CHECKPOINT_EVERY to 4*T when "
+                         "DM_CHECKPOINT_EVERY is unset or T does not "
+                         "tile it")
     ap.add_argument("--drops", default="off", choices=["off", "on"],
                     help="arm a mid-run 10%% drop window (the "
                          "masks-as-inputs composition rungs; rows carry "
@@ -295,6 +312,7 @@ def main() -> int:
                              probe_gather=args.probe_gather,
                              fused_probe=args.fused_probe == "on",
                              drops=args.drops == "on",
+                             mega_ticks=args.mega_ticks,
                              trace_dir=args.trace_dir, runlog=runlog)
             print(json.dumps(rec), flush=True)
     return 0

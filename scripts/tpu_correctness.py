@@ -7,8 +7,10 @@ running the full `tpu_hash` scan under each mode on the real chip (same
 seed) and comparing final states bit-for-bit: the receive kernel under
 drops, the gossip kernel and the two-kernel composition drop-free, the
 masks-as-inputs gossip kernel under drops, the fused probe/agg
-traversal (natural + folded), and the folded S=16 layout vs the
-natural one (droppy).  Exit 0 = all identical.  The comparison is
+traversal (natural + folded), the folded S=16 layout vs the
+natural one (droppy), and the T-tick megakernel scan with the packed
+carry at each banked block size (droppy, mega_t{T} families).
+Exit 0 = all identical.  The comparison is
 same-platform only: each variant vs the baseline on whatever backend
 resolve_platform selects.
 
@@ -28,7 +30,7 @@ sys.path.insert(0, REPO)
 def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
              n: int = 8192, s: int = 128, ticks: int = 60,
              folded: bool = False, sharded: bool = False,
-             fused_probe: bool = False):
+             fused_probe: bool = False, mega: int = 0):
     """One full scan; returns the flattened final-state pytree.
 
     ``sharded`` runs the SAME config on BACKEND tpu_hash_sharded over a
@@ -59,7 +61,11 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"FAIL_TIME: {ticks // 2}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
-        f"FUSED_PROBE: {int(fused_probe)}\nBACKEND: {backend}\n")
+        f"FUSED_PROBE: {int(fused_probe)}\nBACKEND: {backend}\n"
+        # MEGA_TICKS needs chunked segments to tile; K=4T matches the
+        # default profile_step.py picks for its mega timing runs.
+        + (f"CHECKPOINT_EVERY: {4 * mega}\nMEGA_TICKS: {mega}\n"
+           if mega > 0 else ""))
     plan = make_plan(params, _pyrandom.Random("app:0"))
     if sharded:
         from distributed_membership_tpu.backends.tpu_hash_sharded import (
@@ -132,6 +138,19 @@ def main() -> int:
         prob_d = run_once(False, False, True, n=args.n, ticks=args.ticks,
                           fused_probe=True)
         checks["fused_probe"] = diff(base_d, prob_d)
+        # T-tick megakernel scan (ops/megakernel) over the droppy
+        # config: the block-reshaped operands and the packed carry are a
+        # different XLA:TPU program per block size, so each banked T
+        # gates its own family (mega_t{T}) for the *_mega{T} ladder
+        # rungs.  Chunked-vs-monolithic is trajectory-inert (pinned on
+        # CPU by test_checkpoint/test_megakernel), so the per-tick
+        # droppy baseline is the honest reference.
+        from distributed_membership_tpu.backends.tpu_hash import (
+            MEGA_AUTO_TICKS)
+        for t_m in sorted(MEGA_AUTO_TICKS):
+            mg_d = run_once(False, False, True, n=args.n,
+                            ticks=args.ticks, mega=t_m)
+            checks[f"mega_t{t_m}"] = diff(base_d, mg_d)
         # Gossip kernel (single-payload, drop-free), alone and with the
         # receive kernel — the composition FUSED defaults would ship.
         base = run_once(False, False, False, n=args.n, ticks=args.ticks)
@@ -192,6 +211,14 @@ def main() -> int:
         sh_prob_d = run_once_s(False, False, True, n=args.n,
                                ticks=args.ticks, fused_probe=True)
         checks["sharded_fused_probe"] = diff(sh_base_d, sh_prob_d)
+        # Megakernel scan inside shard_map (seg_run's mega routing) —
+        # the sharded twins of the mega_t{T} families.
+        from distributed_membership_tpu.backends.tpu_hash import (
+            MEGA_AUTO_TICKS)
+        for t_m in sorted(MEGA_AUTO_TICKS):
+            sh_mg_d = run_once_s(False, False, True, n=args.n,
+                                 ticks=args.ticks, mega=t_m)
+            checks[f"sharded_mega_t{t_m}"] = diff(sh_base_d, sh_mg_d)
         sh_base = run_once_s(False, False, False, n=args.n,
                              ticks=args.ticks)
         sh_goss = run_once_s(False, True, False, n=args.n,

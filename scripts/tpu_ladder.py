@@ -205,6 +205,13 @@ LADDER = [
     ("1M_s16_fprobe",    1 << 20,  16,  60, "folded_fprobe", 1200),
     ("1M_s16_fboth_drop", 1 << 20, 16,  60, "folded_fboth_drop", 1200),
     ("1M_s16_fall",      1 << 20,  16,  60, "folded_fall", 1200),
+    # Multi-tick residency: the fully-fused folded program under the
+    # T-tick megakernel scan (MEGA_TICKS, ops/megakernel) at both banked
+    # block sizes (tpu_hash.MEGA_AUTO_TICKS).  64 ticks so T=32 still
+    # runs two full blocks; gated fail-closed on the mega_t{T}
+    # correctness families plus the folded/fused ones the program rides.
+    ("1M_s16_mega8",     1 << 20,  16,  64, "folded_mega8", 1200),
+    ("1M_s16_mega32",    1 << 20,  16,  64, "folded_mega32", 1200),
     ("524k_s64",         1 << 19,  64,  60, "off",    600),
     ("1M_s64_folded",    1 << 20,  64,  60, "folded", 900),
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
@@ -340,27 +347,32 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
                "--phase", fused]   # phase rides the mode slot
     else:
+        # folded_mega{T} modes run folded_fall's program under the
+        # T-tick megakernel scan; T rides the mode-string suffix.
+        mega_t = (int(fused.rsplit("mega", 1)[1])
+                  if fused.startswith("folded_mega") else 0)
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
+               "--mega-ticks", str(mega_t),
                "--fused",
                "on" if fused in ("recv", "both", "folded_fboth",
                                  "folded_fboth_drop", "folded_fall")
-               else "off",
+               or mega_t else "off",
                "--fused-gossip",
                "on" if fused in ("gossip", "both", "folded_fboth",
                                  "folded_fboth_drop", "folded_fall")
-               else "off",
+               or mega_t else "off",
                "--fused-probe",
                "on" if fused in ("folded_fprobe", "folded_fall")
-               else "off",
+               or mega_t else "off",
                "--drops",
                "on" if fused.endswith("_drop") else "off",
                "--folded",
                "on" if fused in ("folded", "folded_fboth", "folded_sw16",
                                  "folded_fprobe", "folded_fboth_drop",
                                  "folded_fall")
-               else "off",
+               or mega_t else "off",
                "--shift-set",
                "16" if fused in ("sw16", "folded_sw16") else "0",
                "--prng", "rbg" if fused == "rbg" else "threefry2x32",
@@ -520,6 +532,19 @@ def _rung_gated(rung, corr) -> bool:
         # Same fail-closed rule for the probe-kernel families: a verdict
         # from before fused_probe existed must not green-light its rungs.
         return True
+    if mode.startswith("folded_mega"):
+        # Multi-tick residency rungs: need the mega_t{T} family banked
+        # AND clean, plus every folded/fused family the fully-fused
+        # folded program rides — a verdict from before the megakernel
+        # existed must not green-light its rungs (fail closed; the
+        # script emits every family key, so absence = never checked).
+        t_m = int(mode.rsplit("mega", 1)[1])
+        mism = corr.get("mismatched_elements", {})
+        keys = (f"mega_t{t_m}", f"folded_s{view}",
+                f"folded_fused_s{view}", f"folded_fused_probe_s{view}")
+        if not all(k in mism for k in keys):
+            return True
+        return any(bool(mism.get(k)) for k in keys)
     if corr.get("ok", False):
         return False
     mism = corr.get("mismatched_elements", {})
@@ -569,7 +594,8 @@ def _corr_covers_ladder(rec) -> bool:
 # covered, without smearing onto families another arm re-checks.
 ARM_FAMILIES = {
     "fused_correctness": ("fused_receive", "fused_gossip", "fused_both",
-                          "fused_gossip_drops", "fused_probe"),
+                          "fused_gossip_drops", "fused_probe",
+                          "mega_t8", "mega_t32"),
     "folded_correctness": ("folded_s16", "folded_fused_s16",
                            "folded_fused_probe_s16",
                            "folded_s64", "folded_fused_s64",
@@ -583,7 +609,8 @@ ARM_FAMILIES = {
                             "sharded_folded_fused_probe_s16",
                             "sharded_folded_s64",
                             "sharded_folded_fused_s64",
-                            "sharded_folded_fused_probe_s64"),
+                            "sharded_folded_fused_probe_s64",
+                            "sharded_mega_t8", "sharded_mega_t32"),
 }
 
 

@@ -189,6 +189,41 @@ def test_fused_census_budget_at_1m_s16():
 
 
 @pytest.mark.quick
+def test_mega_census_budget_at_1m_s16():
+    """Multi-tick-residency structural budget at the [1M, 16] north-star
+    geometry (scripts/hlo_census.py mega_census — the SEGMENT-runner
+    programs over a K = 2T segment of the fully-fused droppy step):
+
+      * ``MEGA_TICKS: 1`` is OP-COUNT IDENTICAL to the PR-8 per-tick
+        program (every counter — T <= 1 bypasses the block machinery
+        entirely, so the identity holds by construction and this pin
+        keeps it that way), and
+      * the T=8 block program keeps the Pallas-call census at the PR-8
+        budget of 3 (+0 here; <= 3 + O(1), NOT 3·T — the jaxpr walk
+        counts scan bodies once, so an unrolled implementation would
+        show 3·T = 24), adds zero new [N]-class gathers or scatters and
+        zero threefry draws, and the shrunk-carry codec contributes only
+        a bounded handful of elementwise [N, S]-class pack/unpack ops.
+    """
+    out = hlo_census.mega_census(n=1 << 20, s=16, t=8)
+    pl, m1, mg = out["plain"], out["mega_t1"], out["mega"]
+
+    assert m1 == pl, (m1, pl)
+
+    assert pl["pallas_calls"] == 3, pl
+    assert mg["pallas_calls"] == 3, mg          # 3 + O(1), not 3*T
+    assert mg["big_gathers"] == pl["big_gathers"], (mg, pl)
+    assert mg["big_gather_shapes"] == pl["big_gather_shapes"]
+    assert mg["big_scatters"] == pl["big_scatters"], (mg, pl)
+    assert mg["threefry_calls"] == pl["threefry_calls"], (mg, pl)
+    # Codec additions (measured +15: the u16 pair pack/unpack of
+    # view_ts and the block-boundary restitch) stay elementwise and
+    # bounded — never a new memory-pass class.
+    assert 0 <= (mg["ns_class_ops"] - pl["ns_class_ops"]) <= 32, (
+        mg["ns_class_ops"], pl["ns_class_ops"])
+
+
+@pytest.mark.quick
 def test_census_exact_mode_single_gather():
     """PROBE_IO exact (the default below 2^17) also rides the single
     combined gather — the DEFAULT exact path was the tentpole's target,

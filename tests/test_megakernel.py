@@ -1,0 +1,469 @@
+"""Multi-tick residency (ops/megakernel + MEGA_TICKS/MEGA_PACK).
+
+Four layers, all bit-exact:
+
+* **Codec units** — the shrunk-carry pack/unpack round trip on named
+  pytrees: bool planes bit-packed with padding, the view_ts/self_hb
+  16-bit pair lanes (incl. the -1 sentinel offset, odd last dims and the
+  folded [N*S/128, 128] plane shapes), raw leaves untouched, and the
+  carry_bytes accounting that PERF.md / the bench row report.
+* **mega_scan units** — the T-block restructured scan == ``lax.scan``
+  for block sizes that tile, don't tile, exceed, and equal the length,
+  packed and wide.
+* **End-to-end twins** — ``MEGA_TICKS: 8`` (packed AND wide carry)
+  reproduces the per-tick chunked run exactly on every ring twin under
+  message drops with the full hist telemetry tree, and composes with
+  the all-fused kernels under a partition + crash + restart + flake
+  scenario; a run killed mid-flight across a T-block boundary resumes
+  to the identical trajectory at several kill ticks.
+* **Static overflow widening** — the 16-bit bound is proven host-side:
+  auto (``MEGA_PACK: -1``) silently widens when the effective run
+  length exceeds megakernel.PACK_SAFE_TICKS, a pinned ``MEGA_PACK: 1``
+  refuses loudly, and every structural misuse of the knobs is rejected
+  with a pinned message.
+"""
+
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.backends.tpu_hash import (
+    make_config, resolve_mega_pack)
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.ops.megakernel import (
+    PACK_SAFE_TICKS, carry_bytes, fits16, make_codec, mega_scan,
+    pack_fits)
+from distributed_membership_tpu.runtime import checkpoint as ck
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# Codec units
+
+
+class _State(NamedTuple):
+    """HashState-shaped miniature: same FIELD NAMES the codec keys on
+    (view_ts/self_hb pack 16-bit; bools bit-pack; the rest stays raw)."""
+    view: jax.Array
+    view_ts: jax.Array
+    started: jax.Array
+    self_hb: jax.Array
+    mail: jax.Array
+
+
+def _rand_state(key, shape_ts=(6, 16), n=6):
+    ks = jax.random.split(key, 5)
+    return _State(
+        view=jax.random.randint(
+            ks[0], shape_ts, 0, 1 << 30).astype(U32),
+        # Timestamps include the -1 "never" sentinel and the top of the
+        # packable range.
+        view_ts=jax.random.randint(ks[1], shape_ts, -1, (1 << 16) - 1),
+        started=jax.random.bernoulli(ks[2], 0.5, (n,)),
+        self_hb=jax.random.randint(ks[3], (n,), -1, 2 * PACK_SAFE_TICKS),
+        mail=jax.random.randint(ks[4], shape_ts, 0, 1 << 30).astype(U32),
+    )
+
+
+def _assert_state_equal(a, b):
+    for name, x, y in zip(_State._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=name)
+        assert x.dtype == y.dtype, name
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("shape_ts,n", [
+    ((6, 16), 6),        # natural [N, S]
+    ((4, 128), 7),       # folded plane rows (odd N bit-pads the bools)
+    ((5, 7), 9),         # odd last dim: u16 pair padding
+    ((3,), 33),          # 1-D plane; bool size % 32 != 0
+], ids=["natural", "folded", "odd_pairs", "flat"])
+def test_codec_roundtrip_exact(shape_ts, n):
+    st = _rand_state(jax.random.PRNGKey(sum(shape_ts) + n), shape_ts, n)
+    pack, unpack = make_codec(st, pack16=True)
+    packed = pack(st)
+    _assert_state_equal(unpack(packed), st)
+
+    # The shrink actually happened: view_ts crossed as u32 pair lanes
+    # over a halved last axis, the bool plane as 32x-fewer u32 words;
+    # view/mail stayed raw u32.
+    names = list(_State._fields)
+    p = dict(zip(names, packed))
+    assert p["view_ts"].dtype == U32
+    assert p["view_ts"].shape[-1] == -(-shape_ts[-1] // 2)
+    assert p["started"].dtype == U32
+    assert p["started"].shape == (-(-n // 32),)
+    assert p["view"].shape == shape_ts and p["view"].dtype == U32
+    assert p["mail"].shape == shape_ts
+
+    # Wide codec: only the bools shrink; the timestamp planes pass raw.
+    pack_w, unpack_w = make_codec(st, pack16=False)
+    pw = dict(zip(names, pack_w(st)))
+    assert pw["view_ts"].shape == shape_ts and pw["view_ts"].dtype == I32
+    assert pw["started"].dtype == U32
+    _assert_state_equal(unpack_w(pack_w(st)), st)
+
+
+@pytest.mark.quick
+def test_codec_works_under_jit():
+    """Classification is static-metadata-only, so the codec must build
+    identically from tracers (the production path: inside the outer
+    scan's jitted block body)."""
+    st = _rand_state(jax.random.PRNGKey(7))
+    pack, unpack = make_codec(st, pack16=True)
+    rt = jax.jit(lambda s: unpack(pack(s)))(st)
+    _assert_state_equal(rt, st)
+
+
+@pytest.mark.quick
+def test_pack_bounds_and_fits16():
+    assert pack_fits(PACK_SAFE_TICKS)
+    assert pack_fits(0)
+    assert not pack_fits(PACK_SAFE_TICKS + 1)
+    assert not pack_fits(-1)
+    # Dynamic twin: the u16+1 round trip covers [-1, 2**16 - 2] exactly.
+    assert fits16([-1, 0, (1 << 16) - 2])
+    assert not fits16([(1 << 16) - 1])
+    assert not fits16([-2])
+
+
+@pytest.mark.quick
+def test_carry_bytes_accounting():
+    st = _rand_state(jax.random.PRNGKey(3), (8, 16), 8)
+    acct = carry_bytes(st, pack16=True)
+    # view/mail raw (2 * 8*16*4) + view_ts halved (8*8*4) + self_hb
+    # halved ([8] -> 4 lanes * 4) + started bit-packed (1 word).
+    assert acct["full"] == (3 * 8 * 16 * 4) + 8 * 4 + 8 * 1
+    assert acct["packed"] == (2 * 8 * 16 * 4) + 8 * 8 * 4 + 4 * 4 + 4
+    assert acct["packed"] < acct["full"]
+    # Wide codec still shrinks the bools, nothing else.
+    wide = carry_bytes(st, pack16=False)
+    assert wide["packed"] == acct["full"] - 8 + 4
+    # ShapeDtypeStructs cost nothing and account identically.
+    sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), st)
+    assert carry_bytes(sds, pack16=True) == acct
+
+
+# ---------------------------------------------------------------------------
+# mega_scan units
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("t", [1, 3, 4, 7, 20, 40])
+@pytest.mark.parametrize("pack16", [False, True])
+def test_mega_scan_matches_lax_scan(t, pack16):
+    """Block sizes that tile L=20 (4), don't (3, 7), T=1 (bypass),
+    T=L and T>L (single plain scan) — all bit-identical to lax.scan,
+    carry AND stacked ys."""
+    st = _rand_state(jax.random.PRNGKey(t), (4, 6), 5)
+
+    def body(s, x):
+        t_i, bump = x
+        s = s._replace(
+            view_ts=jnp.where(s.view % 3 == 0, t_i, s.view_ts),
+            self_hb=s.self_hb + 2,
+            started=s.started ^ (bump > 0),
+            mail=s.mail + bump.astype(U32))
+        return s, (s.self_hb.sum(), s.started.any())
+
+    xs = (jnp.arange(20, dtype=I32),
+          jax.random.randint(jax.random.PRNGKey(9), (20,), 0, 2))
+    ref_c, ref_ys = jax.lax.scan(body, st, xs)
+    got_c, got_ys = mega_scan(body, st, xs, t, pack16)
+    _assert_state_equal(got_c, ref_c)
+    for r, g in zip(ref_ys, got_ys):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+# ---------------------------------------------------------------------------
+# Structural rejections (pinned refusal texts)
+
+
+@pytest.mark.quick
+def test_mega_structural_rejections():
+    base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 100\n"
+            "FAIL_TIME: 50\nJOIN_MODE: warm\nEVENT_MODE: agg\n")
+    ring = base + "EXCHANGE: ring\nBACKEND: tpu_hash\n"
+
+    with pytest.raises(ValueError, match="MEGA_TICKS must be -1"):
+        Params.from_text(ring + "CHECKPOINT_EVERY: 40\nMEGA_TICKS: -2\n")
+    # Only the ring-family scan runners block the scan.
+    with pytest.raises(ValueError, match="ring backends only"):
+        Params.from_text(base + "BACKEND: tpu_sparse\n"
+                         "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 8\n")
+    # Blocks align to segment boundaries: chunking must exist and T
+    # must tile it.
+    with pytest.raises(ValueError,
+                       match="requires CHECKPOINT_EVERY > 0"):
+        Params.from_text(ring + "MEGA_TICKS: 8\n")
+    with pytest.raises(ValueError, match="must tile"):
+        Params.from_text(ring + "CHECKPOINT_EVERY: 50\nMEGA_TICKS: 8\n")
+    with pytest.raises(ValueError, match="MEGA_PACK must be"):
+        Params.from_text(ring + "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 8\n"
+                         "MEGA_PACK: 2\n")
+    with pytest.raises(ValueError, match="MEGA_PACK: 1 requires"):
+        Params.from_text(ring + "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 0\n"
+                         "MEGA_PACK: 1\n")
+
+    # make_config layer: the resolved exchange gates the pinned knob —
+    # the scatter lowering keeps the per-tick scan.
+    with pytest.raises(ValueError, match="requires the ring exchange"):
+        make_config(Params.from_text(
+            base + "EXCHANGE: scatter\nBACKEND: tpu_hash\n"
+            "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 8\n"))
+    # A pinned pack with no T-block boundary to shrink.
+    with pytest.raises(ValueError, match="MEGA_PACK: 1 requires "
+                       "MEGA_TICKS >= 2"):
+        make_config(Params.from_text(
+            ring + "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 1\n"
+            "MEGA_PACK: 1\n"))
+    # A pinned pack whose declared run length breaks the 16-bit bound.
+    long = ring.replace("TOTAL_TIME: 100",
+                        f"TOTAL_TIME: {PACK_SAFE_TICKS + 1}")
+    with pytest.raises(ValueError, match="cannot prove the 16-bit"):
+        make_config(Params.from_text(
+            long + "CHECKPOINT_EVERY: 40\nMEGA_TICKS: 8\n"
+            "MEGA_PACK: 1\n"))
+
+
+@pytest.mark.quick
+def test_mega_pack_overflow_widening_is_static():
+    """Auto (-1) proves the bound host-side: within it the config packs;
+    beyond it the SAME knob silently widens (auto never raises), both at
+    make_config (declared TOTAL_TIME) and at run_scan's effective-length
+    re-proof (resolve_mega_pack) — a longer total override widens an
+    auto pack and refuses a pinned one."""
+    ring = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: {total}\n"
+            "FAIL_TIME: 50\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "EXCHANGE: ring\nBACKEND: tpu_hash\nCHECKPOINT_EVERY: 40\n"
+            "MEGA_TICKS: 8\n")
+    p_small = Params.from_text(ring.format(total=100))
+    cfg = make_config(p_small)
+    assert cfg.mega_ticks == 8 and cfg.mega_pack is True
+
+    p_long = Params.from_text(ring.format(total=PACK_SAFE_TICKS + 1))
+    assert make_config(p_long).mega_pack is False      # auto widened
+
+    # Effective-length re-proof: same cfg, longer actual run.
+    assert resolve_mega_pack(cfg, p_small, 100) is cfg
+    widened = resolve_mega_pack(cfg, p_small, PACK_SAFE_TICKS + 1)
+    assert widened.mega_pack is False and widened.mega_ticks == 8
+    p_pinned = Params.from_text(ring.format(total=100) + "MEGA_PACK: 1\n")
+    cfg_pinned = make_config(p_pinned)
+    with pytest.raises(ValueError, match="effective run length"):
+        resolve_mega_pack(cfg_pinned, p_pinned, PACK_SAFE_TICKS + 1)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end twins: MEGA_TICKS on (packed and wide) == off, droppy,
+# full telemetry tree, every ring twin.
+
+
+_E2E_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+    "DROP_START: 10\nDROP_STOP: 50\nGOSSIP_LEN: {g}\nPROBES: {p}\n"
+    "FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+    "VIEW_SIZE: {s}\nJOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "TELEMETRY: hist\nCHECKPOINT_EVERY: 24\n")
+
+
+def _assert_same_run(r0, r1):
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    tl0, tl1 = r0.extra["timeline"], r1.extra["timeline"]
+    assert set(tl0) == set(tl1)
+    for k in tl0:
+        np.testing.assert_array_equal(np.asarray(tl0[k]),
+                                      np.asarray(tl1[k]), err_msg=k)
+
+
+# All four twins ride the slow tier: each arm is three full jit
+# compiles (~27 s for natural alone), and tier-1 already pins a full
+# mega run twice over — the chaos composition arm (fused kernels +
+# scenario) and the kill/resume boundary arm both run non-slow.
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    "BACKEND: tpu_hash\n",
+    "BACKEND: tpu_hash\nFOLDED: 1\n",
+    "BACKEND: tpu_hash_sharded\n",
+    "BACKEND: tpu_hash_sharded\nFOLDED: 1\n",
+], ids=["natural", "folded", "sharded", "sharded_folded"])
+def test_mega_e2e_droppy(extra):
+    """MEGA_TICKS: 8 (T tiles K=24; the final 12-tick segment runs one
+    8-block + a 4-tick plain tail) reproduces the per-tick chunked run
+    exactly on each ring twin — trajectory, detection summary, every
+    telemetry series — with the shrunk carry AND the wide carry."""
+    import warnings
+
+    backend = ("tpu_hash_sharded" if "sharded" in extra else "tpu_hash")
+    folded = "FOLDED" in extra
+    n = 512 if (folded and "sharded" in extra) else 256
+    conf = _E2E_CONF.format(n=n, s=16 if folded else 128,
+                            g=8 if folded else 16,
+                            p=2 if folded else 16)
+
+    def run(mega):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend(backend)(
+                Params.from_text(conf + extra + mega), seed=3)
+
+    r_off = run("MEGA_TICKS: 0\n")
+    _assert_same_run(r_off, run("MEGA_TICKS: 8\nMEGA_PACK: 1\n"))
+    _assert_same_run(r_off, run("MEGA_TICKS: 8\nMEGA_PACK: 0\n"))
+
+
+# ---------------------------------------------------------------------------
+# Mega x all-fused x scenario chaos: the composition contract.
+
+
+_CHAOS_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "GOSSIP_LEN: {g}\nPROBES: {p}\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+    "TOTAL_TIME: 170\nVIEW_SIZE: {s}\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+    "EXCHANGE: ring\nTELEMETRY: scalars\nCHECKPOINT_EVERY: 40\n")
+
+
+@pytest.mark.parametrize("extra", [
+    "BACKEND: tpu_hash\nFUSED_RECEIVE: 1\nFUSED_GOSSIP: 1\n"
+    "FUSED_PROBE: 1\n",
+    pytest.param("BACKEND: tpu_hash\nFOLDED: 1\nFUSED_RECEIVE: 1\n"
+                 "FUSED_GOSSIP: 1\nFUSED_PROBE: 1\n",
+                 marks=pytest.mark.slow),
+    pytest.param("BACKEND: tpu_hash_sharded\n", marks=pytest.mark.slow),
+], ids=["natural_fused", "folded_fused", "sharded"])
+def test_mega_chaos_bit_exact(extra, tmp_path):
+    """T-blocking composes with the fused kernels and the scenario
+    engine: partition + crash + restart + link_flake under MEGA_TICKS: 8
+    == the per-tick run, bit-exactly (scenario cuts arrive as per-tick
+    stacked operands; the block restructuring only re-batches them)."""
+    import json
+    import warnings
+
+    backend = ("tpu_hash_sharded" if "sharded" in extra else "tpu_hash")
+    folded = "FOLDED" in extra
+    n = 256
+    events = [
+        {"kind": "partition", "start": 20, "stop": 80,
+         "groups": [[0, n // 2], [n // 2, n]]},
+        {"kind": "crash", "time": 30, "range": [4, 8]},
+        {"kind": "restart", "time": 100, "range": [4, 8]},
+        {"kind": "link_flake", "start": 110, "stop": 150,
+         "src": [0, n // 2], "dst": [n // 2, n], "drop_prob": 0.2},
+    ]
+    spath = tmp_path / "chaos.json"
+    spath.write_text(json.dumps({"name": "chaos", "events": events}))
+    conf = (_CHAOS_CONF.format(n=n, s=16 if folded else 128,
+                               g=8 if folded else 16,
+                               p=2 if folded else 16)
+            + f"SCENARIO: {spath}\n" + extra)
+
+    def run(mega):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_backend(backend)(
+                Params.from_text(conf + f"MEGA_TICKS: {mega}\n"), seed=5)
+
+    r0, r1 = run(0), run(8)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    assert r0.extra["scenario_report"] == r1.extra["scenario_report"]
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    # The chaos actually happened — guard against a silently inert
+    # scenario making the bit-equality vacuous.
+    rep = r0.extra["scenario_report"]
+    assert rep["partitions"][0]["removals_during"] > 0
+    assert rep["restarts"][0]["rejoined"] is True
+
+
+# ---------------------------------------------------------------------------
+# Kill/resume across a T-block boundary.
+
+
+_KR_CONF = (
+    "MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+    "DROP_START: 60\nDROP_STOP: 200\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
+    "PROBES: 2\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 440\n"
+    "FAIL_TIME: 100\nJOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "BACKEND: tpu_hash\n")
+
+
+@pytest.mark.parametrize("kill", [
+    50,
+    pytest.param(150, marks=pytest.mark.slow),
+    pytest.param(400, marks=pytest.mark.slow),
+])
+def test_mega_kill_resume_bit_exact(kill, tmp_path, monkeypatch):
+    """A MEGA_TICKS: 8 run killed mid-flight (kill 50 lands inside a
+    T-block, before FAIL_TIME; 150 inside the drop window; 400 exactly
+    on a segment boundary) resumes from the durable full-width snapshot
+    to the same trajectory as the uninterrupted PER-TICK run — the
+    checkpoint identity excludes the mega knobs, so the resumed blocks
+    re-derive the identical stream alignment."""
+    ref = get_backend("tpu_hash")(Params.from_text(_KR_CONF), seed=3)
+
+    ckdir = tmp_path / "ck"
+    mega_keys = (f"CHECKPOINT_EVERY: 40\nCHECKPOINT_DIR: {ckdir}\n"
+                 "MEGA_TICKS: 8\n")
+    monkeypatch.setenv(ck.CRASH_ENV, str(kill))
+    with pytest.raises(RuntimeError, match="injected crash"):
+        get_backend("tpu_hash")(Params.from_text(_KR_CONF + mega_keys),
+                                seed=3)
+    # The fault fires at the first segment boundary past the kill tick;
+    # every completed segment left a durable snapshot behind it.
+    assert ck.manifest_tick(str(ckdir)) == -(-kill // 40) * 40
+
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r = get_backend("tpu_hash")(
+        Params.from_text(_KR_CONF + mega_keys + "RESUME: 1\n"), seed=3)
+    assert (r.extra["detection_summary"]
+            == ref.extra["detection_summary"])
+    np.testing.assert_array_equal(r.sent, ref.sent)
+    np.testing.assert_array_equal(r.recv, ref.recv)
+    f0, f1 = ref.extra["final_state"], r.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+
+
+@pytest.mark.quick
+def test_mega_knobs_are_trajectory_inert_in_identity():
+    """Resuming a per-tick checkpoint under MEGA_TICKS (or vice versa)
+    is legal: the snapshot is always the full-width carry at a segment
+    boundary, so the mega knobs stay out of the manifest identity like
+    CHECKPOINT_EVERY itself."""
+    base = ("MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+            "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\n"
+            "FANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 100\n"
+            "FAIL_TIME: 50\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+            "EXCHANGE: ring\nBACKEND: tpu_hash\nCHECKPOINT_EVERY: 40\n")
+    p0 = Params.from_text(base)
+    p1 = Params.from_text(base + "MEGA_TICKS: 8\nMEGA_PACK: 1\n")
+    assert ck.params_identity(p0) == ck.params_identity(p1)

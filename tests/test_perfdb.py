@@ -37,6 +37,33 @@ def test_make_row_key_and_digest():
 
 
 @pytest.mark.quick
+def test_make_row_keys_mega_rows_per_block_size():
+    """Multi-tick-residency rows key by (rung, T): a truthy
+    knobs["mega_ticks"] lifts the block size into the rung (rung:t{T}),
+    so a T=8 trend and a T=32 trend are separate --check histories and
+    a regression report names the block size, not a digest."""
+    def row(t, value):
+        return perfdb.make_row(
+            "bench:live:hash:mega", metric="mega_speedup_pct",
+            value=value, n=65536, s=16, backend="tpu_hash",
+            platform="cpu", knobs={"mega_ticks": t, "ticks": 400})
+
+    r8, r32 = row(8, 10.0), row(32, 12.0)
+    assert r8["rung"] == "bench:live:hash:mega:t8"
+    assert r32["rung"] == "bench:live:hash:mega:t32"
+    assert r8["key"] != r32["key"]
+    # Cross-masking guard: a healthy T=8 history must not absorb a T=32
+    # collapse (same rung string would have compared them jointly).
+    hist = [row(8, 10.0), row(32, 12.0), row(8, 9.5), row(32, 2.0)]
+    bad = perfdb.check(hist)
+    assert len(bad) == 1 and bad[0]["rung"] == "bench:live:hash:mega:t32"
+    # Non-mega rows are untouched (mega_ticks absent or zero).
+    plain = perfdb.make_row("bench:live:hash", metric="m", value=1.0,
+                            knobs={"mega_ticks": 0})
+    assert plain["rung"] == "bench:live:hash"
+
+
+@pytest.mark.quick
 def test_append_is_idempotent_and_torn_tolerant(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     rows = [perfdb.make_row("r", metric="m", value=v, source="s",
