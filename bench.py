@@ -44,7 +44,12 @@ the in-graph bucketed one-hot reductions; its overhead row lands in
 PERF.md), BENCH_MEGA=T re-times the leg with the T-tick megakernel scan
 (MEGA_TICKS — ops/megakernel; carry resident across T inner ticks,
 shrunk at block boundaries) against the same per-tick chunked program,
-interleaved; banked as bench:live:hash:mega keyed per block size.
+interleaved; banked as bench:live:hash:mega keyed per block size,
+BENCH_EXCHANGE=1 re-times the leg on the SHARDED backend with the
+batched fanout exchange on vs off (EXCHANGE_MODE — ops/exchange: the
+whole gossip fanout as one all_to_all per tick), interleaved; banked as
+bench:live:hash:exchange (keyed rung:p{P} under a DM_DIST_* multi-
+process run).
 
 Every live leg row is also banked into ``artifacts/perf_ledger.jsonl``
 (observability/perfdb.py) and checked against history; a regression
@@ -875,6 +880,44 @@ def leg_hash(n: int, ticks: int, pin: str | None,
             "mega_carry_bytes_full": acct["full"],
             "mega_carry_bytes_packed": acct["packed"],
         })
+    # BENCH_EXCHANGE=1: price the pod-scale batched fanout exchange
+    # (EXCHANGE_MODE batched — ops/exchange.BatchedExchange: every
+    # gossip shift bucketed per destination and shipped as ONE
+    # all_to_all per tick, consumed at the NEXT tick's head) against the
+    # legacy per-shift ppermute rounds, both arms on the SHARDED backend
+    # over a mesh of all local devices.  Interleaved best-of-R like the
+    # other few-percent legs; reported positive = batched wins.
+    # Meaningful only on a multi-device host (CPU twin:
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8); with one
+    # device both arms skip the collective entirely.
+    if os.environ.get("BENCH_EXCHANGE", "0") not in ("", "0"):
+        from distributed_membership_tpu.backends.tpu_hash_sharded import (
+            bind_run_scan)
+        from distributed_membership_tpu.parallel.mesh import make_mesh
+
+        x_mesh = make_mesh()
+        run_sharded = bind_run_scan(x_mesh)
+
+        def _x_params(mode: str):
+            return Params.from_text(
+                geom_text + fused_keys
+                + f"SHIFT_SET: {shift_set}\nEXCHANGE: ring\n"
+                f"EXCHANGE_MODE: {mode}\nBACKEND: tpu_hash_sharded\n")
+
+        p_x_leg, p_x_bat = _x_params("legacy"), _x_params("batched")
+        reps = int(os.environ.get("BENCH_EXCHANGE_REPS", "3"))
+        x_base_wall, _ = _timed_runs(run_sharded, p_x_leg, plan, ticks)
+        walls = _interleaved_best(run_sharded, ticks, (p_x_leg, plan),
+                                  {"batched": (p_x_bat, plan)}, reps,
+                                  x_base_wall)
+        ckpt_fields.update({
+            "exchange_devices": x_mesh.size,
+            "exchange_legacy_wall_seconds": round(walls["base"], 3),
+            "exchange_batched_wall_seconds": round(walls["batched"], 3),
+            "exchange_speedup_pct": round(
+                100 * (walls["base"] - walls["batched"])
+                / max(walls["base"], 1e-9), 1),
+        })
     # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
     # (scenario/compile.py) at this leg's geometry, isolating the two
     # cost classes:
@@ -1182,6 +1225,29 @@ def _ledger_bank(leg: str, row: dict) -> None:
                        "fused_wall_seconds": row.get("fprobe_wall_seconds"),
                        "ticks": row.get("ticks")},
                 source="bench.py"))
+        if row.get("exchange_batched_wall_seconds"):
+            # The BENCH_EXCHANGE companion row: batched-vs-legacy gossip
+            # exchange delta on the sharded backend (positive = the
+            # single-all_to_all fanout wins).  A truthy knobs["procs"]
+            # (set when the row comes from a DM_DIST_* multi-process
+            # run) keys the rung per process topology (rung:p{P}).
+            x_knobs = {"devices": row.get("exchange_devices"),
+                       "legacy_wall_seconds":
+                       row.get("exchange_legacy_wall_seconds"),
+                       "batched_wall_seconds":
+                       row.get("exchange_batched_wall_seconds"),
+                       "ticks": row.get("ticks")}
+            procs = int(os.environ.get("DM_DIST_PROCS", "1") or 1)
+            if procs > 1:
+                x_knobs["procs"] = procs
+            rows.append(perfdb.make_row(
+                f"bench:live:{leg}:exchange",
+                metric="exchange_speedup_pct",
+                value=row["exchange_speedup_pct"], n=row.get("n"),
+                s=row.get("view_size"),
+                backend="tpu_hash_sharded",
+                platform=row.get("platform"),
+                knobs=x_knobs, source="bench.py"))
         if row.get("mega_ticks"):
             # The BENCH_MEGA companion row: T-tick blocked scan vs the
             # per-tick chunked program (positive = residency wins).

@@ -399,6 +399,19 @@ class HashConfig:
     #                              over tensors the step already holds:
     #                              no RNG, no gathers, no scatters
     #                              (census-pinned).  Implies telemetry.
+    batched_exchange: bool = False  # ring gossip shifts cross shards as
+    #                              ONE all_to_all per tick (sender-side
+    #                              alignment + per-destination max/sum
+    #                              combine, ops/exchange.py) instead of
+    #                              one masked ppermute rotation per shift
+    #                              per mesh axis, and the result double-
+    #                              buffers through the scan carry so the
+    #                              collective overlaps the probe/agg tail
+    #                              (EXCHANGE_MODE; tpu_hash_sharded only
+    #                              — the single-chip ring has no
+    #                              cross-shard wire, so the knob is
+    #                              structurally inert there).  Bit-exact
+    #                              vs legacy: tests/test_exchange.py.
     scenario: object = None      # General-path scenario structural
     #                              descriptor (scenario/compile.py
     #                              ScenarioStatic — hashable, so it keys
@@ -1768,6 +1781,34 @@ def make_config(params: Params, collect_events: bool = True,
                 f"at most {_MEGA_PACK_SAFE} ticks — "
                 "ops/megakernel.PACK_SAFE_TICKS); use MEGA_PACK 0 or "
                 "-1 (auto widens to the full-width carry)")
+    # --- pod-scale exchange wire (EXCHANGE_MODE) ------------------------
+    # Batching exists only where the gossip shifts cross shards: the
+    # sharded ring step.  The single-chip ring twins have no exchange
+    # collective, so the knob is structurally inert there (a pinned
+    # 'batched' run is trivially bit-exact with legacy) — inert, not an
+    # error, so one conf can drive all four ring twins (the
+    # tests/test_exchange.py pin matrix).  Pinned 'batched' on a scatter
+    # lowering raises loudly (nothing to batch); auto resolves batched
+    # only on a real TPU with the banked exchange family for this layout
+    # (fail closed, exactly the FUSED_*/MEGA posture above).
+    xm_knob = params.EXCHANGE_MODE
+    batched_x = False
+    if params.BACKEND == "tpu_hash_sharded":
+        if xm_knob == "batched":
+            if exchange != "ring":
+                raise ValueError(
+                    "EXCHANGE_MODE batched requires the ring exchange on "
+                    "tpu_hash_sharded (the scatter lowering has no "
+                    "per-shift collective round to batch)")
+            batched_x = True
+        elif xm_knob == "-1" and exchange == "ring":
+            from distributed_membership_tpu.runtime.fusegate import (
+                banked_correctness, families_clean, on_tpu)
+            if on_tpu():
+                batched_x = families_clean(
+                    banked_correctness(),
+                    "sharded_folded_exchange_batched" if folded
+                    else "sharded_exchange_batched")
     if params.SHIFT_SET:
         # Loud-rejection policy (same as PROBE_IO approx_lag): off-path
         # layouts must not silently ignore the knob.
@@ -1827,7 +1868,7 @@ def make_config(params: Params, collect_events: bool = True,
         probe_io_none=params.PROBE_IO == "none",
         probe_io_lag=params.PROBE_IO == "approx_lag",
         fused_receive=fused, fused_gossip=fused_g, fused_probe=fused_p,
-        folded=folded,
+        folded=folded, batched_exchange=batched_x,
         mega_ticks=mega, mega_pack=bool(mp_knob),
         send_budget=send_budget, shift_set=params.SHIFT_SET,
         # Normalized so configs whose lowering cannot differ share one

@@ -846,6 +846,17 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
 
     AX = axes if len(axes) > 1 else axes[0]
     block_send = make_block_send(n_shards, axes, axis_sizes or (n_shards,))
+    bx = None
+    if cfg.batched_exchange:
+        # EXCHANGE_MODE batched on folded planes: one all_to_all per
+        # tick with sender-side folded alignment (ops/exchange.py);
+        # result carried one tick in the (state, xbuf) lane — see the
+        # natural twin for the bit-exactness argument.
+        from distributed_membership_tpu.ops.exchange import BatchedExchange
+        bx = BatchedExchange(
+            n_shards=n_shards, axes=axes, n_local=n_local, s=s,
+            cstride=cstride, single_col_roll=single_col_roll,
+            folded=True, lanes=LANES)
 
     from distributed_membership_tpu.ops.rng_plan import (
         RingRng, sharded_ring_rng)
@@ -853,6 +864,9 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
     seed_rows = min(cfg.seed_cap, n)
 
     def step(state, inputs):
+        xbuf = None
+        if bx is not None:
+            state, xbuf = state
         (t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo,
          drop_hi) = inputs[:7]
         me = lax.axis_index(AX)
@@ -901,8 +915,15 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 _will_flush)
             return _will_flush(recv_mask, fail_mask_l, t, fail_time)
 
-        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
-        pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
+        # xbuf head-merge (batched exchange): last tick's collective
+        # lands exactly where the legacy merge becomes observable.
+        pend_eff = state.pending_recv
+        mail_eff = state.mail
+        if bx is not None:
+            pend_eff = pend_eff + bx.merge_pending(xbuf[1])
+            mail_eff = bx.merge_mail(mail_eff, xbuf[0])
+        recv_tick = jnp.where(recv_mask, pend_eff, 0)
+        pending_recv = jnp.where(recv_mask, 0, pend_eff)
 
         # ---- self refresh (warm: join machinery inert) ----
         act = recv_mask & state.in_group
@@ -952,7 +973,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         (view, view_ts, mail, join_mask, rm_ids, numfailed, size, cur_id,
          present, difft) = _folded_receive(
             n, cfg.tfail, cfg.tremove, rep, rowsum, self_mask, node,
-            t, state.view, state.view_ts, state.mail, cand_sf, rcol, act,
+            t, state.view, state.view_ts, mail_eff, cand_sf, rcol, act,
             self_val, fused=cfg.fused_receive, s=s, stride=STRIDE,
             interpret=jax.default_backend() != "tpu", row0=row0)
 
@@ -968,6 +989,9 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
+        bpay = bcnt = None
+        if bx is not None:
+            bpay, bcnt = bx.zero()
         for jshift in range(k_max):
             m = keep & rep(jshift < k_eff)
             u = shifts[jshift]
@@ -996,6 +1020,12 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             sent_gossip = sent_gossip + cnt
             b = u // n_local
             c = lax.rem(u, n_local)
+            if bx is not None:
+                # Sender-side folded alignment + destination bucketing;
+                # the wire hop happens ONCE after the loop.
+                bpay, bcnt = bx.add_shift(bpay, bcnt, payload, cnt,
+                                          b, c, me)
+                continue
             payload_r, cnt_r = block_send((payload, cnt), b)
             cnt_r = jnp.roll(cnt_r, c, axis=0)
             recv_add = recv_add + cnt_r
@@ -1033,6 +1063,12 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 jnp.stack([c for _, c, _, _ in stacked]),
                 jnp.stack([s1 for _, _, s1, _ in stacked]),
                 jnp.stack([s2 for _, _, _, s2 in stacked]))
+        xnew = None
+        if bx is not None:
+            # The tick's ONLY exchange launch; its result rides the
+            # carry to the next head (unconsumed here), so XLA overlaps
+            # the collective with the probe/agg tail below.
+            xnew = bx.exchange(bpay, bcnt)
         sent_tick = sent_gossip
 
         # ---- probe issue (P-folded, shared) ----
@@ -1145,6 +1181,10 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
                 probe_ids1 = jnp.where(up_p, U32(0), probe_ids1)
                 probe_ids2 = jnp.where(up_p, U32(0), probe_ids2)
                 act_prev = act_prev & ~up_now
+            if bx is not None:
+                # Chase the up/down wipe into the fresh exchange (the
+                # legacy merge precedes this wipe; see natural twin).
+                xnew = bx.wipe(*xnew, up_now)
         elif scenario is not None:
             failed = state.failed
         else:
@@ -1170,6 +1210,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             self_hb, mail, state.amail, state.pmail,
             state.joinreq_infl, state.joinrep_infl, pending_recv, agg,
             probe_ids1, probe_ids2, act_prev)
+        if bx is not None:
+            new_state = (new_state, xnew)
         if cfg.telemetry:
             # Sharded flight-recorder scalars: local reductions + one
             # psum each (observability/timeline.py).
@@ -1212,6 +1254,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             return new_state, (out, telem)
         return new_state, out
 
+    step.batched_exchange = bx
     return step
 
 

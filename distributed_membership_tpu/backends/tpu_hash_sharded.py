@@ -367,12 +367,28 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
     AX = axes if len(axes) > 1 else axes[0]
     block_send = make_block_send(n_shards, axes,
                                  axis_sizes or (n_shards,))
+    bx = None
+    if cfg.batched_exchange:
+        # EXCHANGE_MODE batched: the per-shift block_send launches are
+        # replaced by ONE all_to_all per tick (ops/exchange.py), its
+        # result carried one tick in the (state, xbuf) lane and merged
+        # at the next head — where the receive pass consumes mail in
+        # both modes, so the deferral is bit-exact while the collective
+        # overlaps this tick's probe/agg tail.
+        from distributed_membership_tpu.ops.exchange import BatchedExchange
+        bx = BatchedExchange(
+            n_shards=n_shards, axes=axes, n_local=n_local, s=s,
+            cstride=cstride,
+            single_col_roll=(n_local * STRIDE) % s == 0, folded=False)
 
     from distributed_membership_tpu.ops.rng_plan import sharded_ring_rng
     packed_gather = cfg.probe_gather == "packed" and n >= 4
     seed_rows = min(cfg.seed_cap, n)
 
     def step(state: ShardedHashState, inputs):
+        xbuf = None
+        if bx is not None:
+            state, xbuf = state
         (t, key, start_ticks_g, fail_mask_g, fail_time, drop_lo,
          drop_hi) = inputs[:7]
         me = lax.axis_index(AX)
@@ -482,8 +498,16 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             sent_req = sent_rep = jnp.zeros((n_local,), I32)
             pending_joins = jnp.zeros((n_local,), I32)
 
-        recv_tick = jnp.where(recv_mask, state.pending_recv, 0)
-        pending_recv = (jnp.where(recv_mask, 0, state.pending_recv)
+        # xbuf head-merge: last tick's batched exchange lands here —
+        # exactly where the legacy (immediately merged) value becomes
+        # observable, so pend_eff/mail_eff equal the legacy carries.
+        pend_eff = state.pending_recv
+        mail_eff = state.mail
+        if bx is not None:
+            pend_eff = pend_eff + bx.merge_pending(xbuf[1])
+            mail_eff = bx.merge_mail(mail_eff, xbuf[0])
+        recv_tick = jnp.where(recv_mask, pend_eff, 0)
+        pending_recv = (jnp.where(recv_mask, 0, pend_eff)
                         + pending_joins)
 
         # ---- self refresh vectors ----
@@ -564,7 +588,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             (lambda *a: receive_core(
                 n, s, cfg.tfail, cfg.tremove, STRIDE, *a)))
         (view, view_ts, mail, join_mask, rm_ids, numfailed,
-         size) = recv_fn(t, state.view, state.view_ts, state.mail,
+         size) = recv_fn(t, state.view, state.view_ts, mail_eff,
                          cand_full, recv_mask, act, self_on, self_val,
                          lrows)
         cur_id, cur_hb, present = unpack(cfg, view)
@@ -613,6 +637,9 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         sent_gossip = jnp.zeros((n_local,), I32)
         recv_add = jnp.zeros((n_local,), I32)
         stacked = []      # (payload_r, c, s1, s2) when cfg.fused_gossip
+        bpay = bcnt = None
+        if bx is not None:
+            bpay, bcnt = bx.zero()
         for j in range(k_max):
             m = keep & (j < k_eff)[:, None]
             u = shifts[j]
@@ -645,6 +672,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             sent_gossip = sent_gossip + cnt
             b = u // n_local
             c = lax.rem(u, n_local)
+            if bx is not None:
+                # Sender-side alignment + destination bucketing; the
+                # wire hop happens ONCE after the loop.
+                bpay, bcnt = bx.add_shift(bpay, bcnt, payload, cnt,
+                                          b, c, me)
+                continue
             payload_r, cnt_r = block_send((payload, cnt), b)
             cnt_r = jnp.roll(cnt_r, c, axis=0)
             recv_add = recv_add + cnt_r
@@ -685,6 +718,13 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 jnp.stack([c for _, c, _, _ in stacked]),
                 jnp.stack([s1 for _, _, s1, _ in stacked]),
                 jnp.stack([s2 for _, _, _, s2 in stacked]))
+        xnew = None
+        if bx is not None:
+            # The tick's ONLY exchange launch.  Its result is NOT
+            # consumed below — it rides the carry to the next head, so
+            # XLA is free to overlap the collective with the probe /
+            # agg tail that follows.
+            xnew = bx.exchange(bpay, bcnt)
         sent_tick = sent_gossip + sent_req + sent_rep
 
         if cold_join:
@@ -875,6 +915,12 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
                 probe_ids1 = jnp.where(rcol_r, U32(0), probe_ids1)
                 probe_ids2 = jnp.where(rcol_r, U32(0), probe_ids2)
                 act_prev = act_prev & ~up_now
+            if bx is not None:
+                # Legacy merges gossip into mail BEFORE this wipe; with
+                # delivery deferred one tick the wipe must chase the
+                # fresh exchange into the xbuf (distributes over the
+                # max/sum head-merge, so the composite equals legacy).
+                xnew = bx.wipe(*xnew, up_now)
         elif scenario is not None:
             failed = state.failed
         else:
@@ -923,6 +969,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             mail, state.amail, state.pmail, joinreq_infl,
             joinrep_infl, pending_recv, agg,
             probe_ids1, probe_ids2, act_prev)
+        if bx is not None:
+            new_state = (new_state, xnew)
         if cfg.telemetry:
             # Sharded flight-recorder scalars: local reductions + one
             # psum each (observability/timeline.py).  The detections
@@ -969,6 +1017,7 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
             return new_state, (out, telem)
         return new_state, out
 
+    step.batched_exchange = bx
     return step
 
 
@@ -1350,6 +1399,18 @@ def reduce_agg(agg: AggStats, ax=NODE_AXIS) -> AggStats:
 _RUNNER_CACHE: dict = {}
 
 
+def carry_state_spec(cfg: HashConfig, axes):
+    """The boundary carry's PartitionSpec tree (shared by _build_step's
+    shard_map specs and the multi-process chunked driver, which must
+    rebuild the global device carry from the host snapshot with exactly
+    these shardings — runtime/distributed.device_put_global)."""
+    agg_t = FastAgg if cfg.fast_agg else AggStats
+    agg_spec = agg_t(*(P() for _ in agg_t._fields))
+    return ShardedHashState(
+        **{f: (agg_spec if f == "agg" else P(axes))
+           for f in ShardedHashState._fields})
+
+
 def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     """(step, init, state_spec, out_spec, AX) — the shared construction of
     the whole-run and chunked segment runners, single-sourced so the two
@@ -1384,11 +1445,7 @@ def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     # The reduced (or untouched-zero) agg is replicated; everything
     # else is node-sharded (over BOTH axes when the mesh is 2-D —
     # P(axes-tuple) is the outer-major flattening AX flattens to).
-    agg_t = FastAgg if cfg.fast_agg else AggStats
-    agg_spec = agg_t(*(P() for _ in agg_t._fields))
-    state_spec = ShardedHashState(
-        **{f: (agg_spec if f == "agg" else P(axes))
-           for f in ShardedHashState._fields})
+    state_spec = carry_state_spec(cfg, axes)
     if cfg.collect_events:
         out_spec = SparseTickEvents(
             join_ids=P(None, axes, None),
@@ -1407,6 +1464,24 @@ def _build_step(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     return step, init, state_spec, out_spec, AX
 
 
+def _xchg_of(step):
+    """The step's BatchedExchange handle (None on the legacy paths).
+
+    The xbuf lane lives strictly INSIDE the scan: runners wrap the
+    boundary carry with a zero xbuf and flush the final one back into
+    mail/pending_recv, so the shard_map boundary (state_spec, the
+    checkpoint codec, resume identity) stays legacy-shaped and
+    EXCHANGE_MODE is trajectory-inert."""
+    return getattr(step, "batched_exchange", None)
+
+
+def _flush_xbuf(carry, bx):
+    state, (xpay, xcnt) = carry
+    return state._replace(
+        mail=bx.merge_mail(state.mail, xpay),
+        pending_recv=state.pending_recv + bx.merge_pending(xcnt))
+
+
 def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
     cache_key = (cfg, n_local, mesh, warm)
     if cache_key not in _RUNNER_CACHE:
@@ -1421,6 +1496,9 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
              drop_lo, drop_hi, warm_key) = args[:8]
             extra = args[8:]
             state0 = init(warm_key)
+            bx = _xchg_of(step)
+            if bx is not None:
+                state0 = (state0, bx.zero())
 
             def body(state, inp):
                 t, k = inp
@@ -1428,6 +1506,8 @@ def _get_runner(cfg: HashConfig, n_local: int, mesh: Mesh, warm: bool):
                                     fail_time, drop_lo, drop_hi) + extra)
 
             final_state, out = lax.scan(body, state0, (ticks, keys))
+            if bx is not None:
+                final_state = _flush_xbuf(final_state, bx)
             if not cfg.collect_events:
                 final_state = final_state._replace(
                     agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
@@ -1507,12 +1587,21 @@ def _get_segment_runner(cfg: HashConfig, n_local: int, mesh: Mesh,
             # on the per-shard leaves (no collectives), so the mega
             # wrapper slots between the agg re-init above and the agg
             # reduction below without touching either.
+            bx = _xchg_of(step)
+            if bx is not None:
+                # The xbuf rides INSIDE the segment only: the boundary
+                # carry stays legacy-shaped (checkpoints / resume
+                # identity unchanged), at the cost of one un-overlapped
+                # head merge per segment boundary.
+                state = (state, bx.zero())
             if cfg.mega_ticks > 1:
                 final_state, out = mega_scan(
                     body, state, (ticks, keys), cfg.mega_ticks,
                     cfg.mega_pack)
             else:
                 final_state, out = lax.scan(body, state, (ticks, keys))
+            if bx is not None:
+                final_state = _flush_xbuf(final_state, bx)
             if not cfg.collect_events:
                 final_state = final_state._replace(
                     agg=(reduce_fast_agg if cfg.fast_agg else reduce_agg)(
@@ -1644,16 +1733,27 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
         init_run = _get_init_runner(cfg, n_local, mesh, warm)
         seg = _get_segment_runner(cfg, n_local, mesh, warm)
         warm_key = make_run_key(params, seed ^ 0x5EED)
+        from distributed_membership_tpu.runtime.distributed import (
+            device_put_global, process_count, to_host)
+        multi = process_count() > 1
+        spec = (carry_state_spec(cfg, tuple(mesh.axis_names))
+                if multi else None)
 
         def segment_fn(carry, *rest):
+            agg_host = None if collect_events else to_host(carry.agg)
+            if multi:
+                # The chunked driver hosts the carry after every
+                # segment (global numpy on every process); rebuild the
+                # global device carry against the mesh before the next
+                # shard_map segment.
+                carry = device_put_global(carry, mesh, spec)
             new_state, ev = seg(carry, *rest)
             if not collect_events:
                 # The carried agg slot is the cross-segment GLOBAL
                 # accumulator; the segment returned its own reduced
                 # contribution — merge host-side (disjoint tick ranges).
                 new_state = new_state._replace(agg=merge_agg(
-                    jax.tree.map(np.asarray, carry.agg),
-                    jax.tree.map(np.asarray, new_state.agg)))
+                    agg_host, to_host(new_state.agg)))
             return new_state, ev
 
         return chunked_run(
@@ -1675,7 +1775,14 @@ def run_scan_sharded(params: Params, plan: FailurePlan, seed: int,
                               fail_time, drop_lo, drop_hi,
                               make_run_key(params, seed ^ 0x5EED),
                               *scn_extra)
-    events = jax.tree.map(np.asarray, events)
+    from distributed_membership_tpu.runtime.distributed import (
+        process_count, to_host)
+    events = to_host(events)
+    if process_count() > 1:
+        # finish_run and the summary readers np.asarray these fields;
+        # gather the global values so every process reports (and logs)
+        # identically.
+        final_state = to_host(final_state)
     if cfg.telemetry:
         events, telem = events
         if telemetry is not None:

@@ -103,6 +103,21 @@ class Params:
     # backends/tpu_hash.py make_step), 'auto' picks ring for warm-join
     # bounded-view scale runs and scatter otherwise.
     EXCHANGE: str = "auto"
+    # Cross-shard wire lowering of the ring gossip shifts on
+    # tpu_hash_sharded (ops/exchange.py): 'legacy' moves each of the
+    # `fanout` shift payloads with its own masked ppermute rotation per
+    # mesh axis (O(fanout*axes) sequential collective launches per
+    # tick), 'batched' aligns every shift on the SENDER, max/sum-combines
+    # same-destination payloads into per-shard buckets, and ships them
+    # all in ONE all_to_all per tick (<= axes collective launches),
+    # double-buffering the result through the scan carry so the
+    # collective overlaps the probe/agg tail of the tick that issued it.
+    # Trajectory-inert: bit-exact vs legacy (tests/test_exchange.py), so
+    # checkpoints ignore it and a resume may switch modes.  '-1' = auto:
+    # batched IFF on a real TPU with a banked bit-exactness verdict for
+    # the exchange family (runtime/fusegate.py — fail closed, exactly
+    # the FUSED_* posture); elsewhere legacy.
+    EXCHANGE_MODE: str = "-1"
     # Run the ring receive pass as one Pallas kernel (ops/fused_receive)
     # instead of the fused-by-XLA jnp expression.  Requires EXCHANGE ring
     # and VIEW_SIZE % 128 == 0; interpret-mode fallback off-TPU.
@@ -422,6 +437,15 @@ class Params:
         if self.EXCHANGE not in ("auto", "scatter", "ring"):
             raise ValueError(
                 f"EXCHANGE must be auto|scatter|ring, got {self.EXCHANGE!r}")
+        if self.EXCHANGE_MODE not in ("-1", "legacy", "batched"):
+            raise ValueError(
+                f"EXCHANGE_MODE must be -1|legacy|batched, got "
+                f"{self.EXCHANGE_MODE!r}")
+        if self.EXCHANGE_MODE == "batched" and self.EXCHANGE == "scatter":
+            raise ValueError(
+                "EXCHANGE_MODE batched applies to the ring exchange's "
+                "gossip shifts (EXCHANGE ring/auto); the scatter lowering "
+                "has no per-shift collective round to batch")
         if self.PRNG_IMPL not in ("threefry2x32", "rbg", "unsafe_rbg"):
             raise ValueError(
                 f"PRNG_IMPL must be threefry2x32|rbg|unsafe_rbg, got "

@@ -67,6 +67,13 @@ def make_row(rung: str, *, metric: str, value: float,
     # regression behind an opaque digest.
     if knobs.get("mega_ticks"):
         rung = f"{rung}:t{int(knobs['mega_ticks'])}"
+    # Multi-process rows key per PROCESS TOPOLOGY the same way: a truthy
+    # knobs["procs"] lifts the process count into the rung (rung:p{P}),
+    # so a single-process trend never masks a pod-run regression (the
+    # cross-process collective legs dominate at P > 1 and the two
+    # operating points move independently).
+    if knobs.get("procs"):
+        rung = f"{rung}:p{int(knobs['procs'])}"
     digest = knobs_digest(knobs)
     key = "|".join([rung, str(n), str(s), str(backend), str(platform),
                     metric, digest])

@@ -1,0 +1,174 @@
+"""Batched fanout exchange: every gossip shift in ONE collective round.
+
+The legacy ring exchange (``tpu_hash_sharded`` / ``tpu_hash_folded``
+gossip loops) pays one ``make_block_send`` launch PER SHIFT — a
+``lax.switch`` whose executed branch is a masked ``ppermute`` rotation
+pair per mesh axis, so a tick costs O(fanout x axes) sequential
+collective launches, each a full DCN round-trip latency at pod scale.
+This module collapses that to O(axes): the SENDER applies the receive
+alignment (block-relative row roll + slot-stride column rolls — all
+per-shard local ops that commute with transport, because transport is a
+pure permutation of whole [L, S] blocks and the alignment constants
+depend only on ``(b, c)`` and the DESTINATION index, which the sender
+knows: ``r = (me + b) mod D``), buckets the aligned payloads by
+destination shard, and ships all buckets in a single tuple-axis
+``lax.all_to_all``.
+
+Bucketing is scatter-free on purpose: destinations are traced scalars,
+so a ``.at[r].max`` combine would emit a scatter per shift — the
+hlo_census gather/scatter budget pins would move, and XLA's scatter is
+the op class the [1M,16] roofline work evicted.  Instead each shift
+folds in with a masked select over the static destination iota
+(``where(iota == r, aligned, 0)`` + ``maximum``), exact because the
+payload combine is a u32 max with identity 0 and the count combine an
+i32 sum with identity 0 — the same associative/commutative merges the
+legacy receiver applies one shift at a time.
+
+The exchanged buffers form the double-buffered carry lane
+(``zero_xbuf`` / head-merge / boundary flush in the step builders):
+tick t's all_to_all result is CONSUMED at tick t+1's head, which is
+exactly when the legacy merge becomes observable (mail is only read by
+the receive pass at the head of the next tick), so deferral is
+bit-exact while freeing XLA to overlap the collective with the probe /
+agg tail of the producing tick.
+
+Wire format: one operand per tick.  Counts ride as extra rows of the
+payload plane — ``[L]`` i32 cast to u32 (counts are nonnegative, the
+cast is exact), zero-padded up to full rows of the payload's lane width
+and concatenated on the row axis — so the collective moves a single
+``[D, rows, lanes]`` array instead of a tuple (one launch, not two).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+class BatchedExchange:
+    """Per-tick batched gossip exchange for one sharded-step build.
+
+    Natural layout: payload planes are ``[L, S]`` (``folded=False``).
+    Folded layout: planes are ``[lf, 128]`` with ``f = 128 // s`` nodes
+    per row (``folded=True``).  Counts are ``[L]`` i32 in both.
+    """
+
+    def __init__(self, *, n_shards: int, axes, n_local: int, s: int,
+                 cstride: int, single_col_roll: bool,
+                 folded: bool = False, lanes: int = 128):
+        self.d = n_shards
+        # Tuple axis ⇒ flattened outer-major semantics: bucket k of the
+        # all_to_all is flat shard k, matching ``me = lax.axis_index(AX)``
+        # in the step builders and the flat-index block arithmetic.
+        self.ax = axes if len(axes) > 1 else axes[0]
+        self.n_local = n_local
+        self.s = s
+        self.cstride = cstride
+        self.single_col_roll = single_col_roll
+        self.folded = folded
+        if folded:
+            self.f = lanes // s
+            self.lf = n_local // self.f
+            self.pay_shape = (n_shards, self.lf, lanes)
+        else:
+            self.pay_shape = (n_shards, n_local, s)
+        self.cnt_shape = (n_shards, n_local)
+        self._l_idx = jnp.arange(n_local, dtype=I32)
+        self._dst_iota = jnp.arange(n_shards, dtype=I32)
+
+    # ---- carry lane -------------------------------------------------
+    def zero(self):
+        """Empty destination buckets / empty carried xbuf (identity of
+        both combines, so a zero xbuf head-merges as a no-op)."""
+        return (jnp.zeros(self.pay_shape, U32),
+                jnp.zeros(self.cnt_shape, I32))
+
+    # ---- sender side ------------------------------------------------
+    def _rep(self, v):
+        # [L] per-node vector -> folded plane (f nodes x s lanes per row).
+        return jnp.repeat(v.reshape(self.lf, self.f), self.s, axis=1,
+                          total_repeat_length=self.pay_shape[2])
+
+    def _align(self, payload, b, c, r):
+        """Apply the receive alignment on the SENDER for destination
+        ``r`` — verbatim the legacy receiver math with ``me := r``."""
+        dd, ll, s = self.d, self.n_local, self.s
+        bp = jnp.where(r < b, b - dd, b)
+        base1 = lax.rem(lax.rem(bp * ll + c, s) + s, s)
+        s1 = lax.rem(base1 * self.cstride, s)
+        base2 = lax.rem(lax.rem(bp * ll + c - ll, s) + s, s)
+        s2 = lax.rem(base2 * self.cstride, s)
+        if self.folded:
+            from distributed_membership_tpu.backends.tpu_hash_folded import (
+                roll_nodes, roll_slots)
+            p = roll_nodes(payload, c, self.f, s)
+            r1 = roll_slots(p, s1, s)
+            if self.single_col_roll:
+                return r1
+            return jnp.where(self._rep(self._l_idx >= c),
+                             r1, roll_slots(p, s2, s))
+        p = jnp.roll(payload, c, axis=0)
+        r1 = jnp.roll(p, s1, axis=1)
+        if self.single_col_roll:
+            return r1
+        return jnp.where((self._l_idx >= c)[:, None],
+                         r1, jnp.roll(p, s2, axis=1))
+
+    def add_shift(self, pay, cnt, payload, cnt_j, b, c, me):
+        """Fold one gossip shift ``u = b*L + c`` into the destination
+        buckets (scatter-free masked combine; see module docstring)."""
+        r = lax.rem(me + b, self.d)
+        aligned = self._align(payload, b, c, r)
+        cnt_r = jnp.roll(cnt_j, c, axis=0)
+        hit = self._dst_iota == r
+        pay = jnp.maximum(pay, jnp.where(hit[:, None, None],
+                                         aligned[None], U32(0)))
+        cnt = cnt + jnp.where(hit[:, None], cnt_r[None], I32(0))
+        return pay, cnt
+
+    # ---- the one collective ----------------------------------------
+    def exchange(self, pay, cnt):
+        """Ship all buckets: ONE ``all_to_all`` across the whole mesh.
+
+        Returns ``(pay_recv, cnt_recv)`` where slice ``k`` is what flat
+        shard ``k`` addressed to this shard (self-delivery included)."""
+        from distributed_membership_tpu.observability.timeline import (
+            PHASE_COLLECTIVE)
+        dd, ll = self.d, self.n_local
+        lanes = self.pay_shape[2]
+        rows = self.pay_shape[1]
+        cnt_u = cnt.astype(U32)
+        pad = (-ll) % lanes
+        if pad:
+            cnt_u = jnp.concatenate(
+                [cnt_u, jnp.zeros((dd, pad), U32)], axis=1)
+        buf = jnp.concatenate([pay, cnt_u.reshape(dd, -1, lanes)], axis=1)
+        if dd > 1:
+            with jax.named_scope(PHASE_COLLECTIVE):
+                buf = lax.all_to_all(buf, self.ax, 0, 0)
+        pay_r = buf[:, :rows]
+        cnt_r = buf[:, rows:].reshape(dd, -1)[:, :ll].astype(I32)
+        return pay_r, cnt_r
+
+    # ---- receiver side (next tick's head / boundary flush) ----------
+    def merge_mail(self, mail, pay_recv):
+        return jnp.maximum(mail, pay_recv.max(0))
+
+    def merge_pending(self, cnt_recv):
+        return cnt_recv.sum(0)
+
+    def wipe(self, pay, cnt, up_now):
+        """Zero a restarting node's undelivered rows in the freshly
+        exchanged buffers.  The legacy step merges gossip into mail
+        BEFORE the scenario up/down wipe; with delivery deferred one
+        tick the wipe must chase it into the xbuf — ``where(mask, 0, .)``
+        distributes over both max and sum, so wiping the two halves
+        separately equals the legacy wipe of the merged value."""
+        plane = self._rep(up_now) if self.folded else up_now[:, None]
+        pay = jnp.where(plane[None], U32(0), pay)
+        cnt = jnp.where(up_now[None, :], I32(0), cnt)
+        return pay, cnt

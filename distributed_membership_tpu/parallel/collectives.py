@@ -51,10 +51,20 @@ def ring_reduce_scatter_max(x: jax.Array, axis_name: str) -> jax.Array:
     # Chunk destined for shard `d` starts at shard `d+1`; from shard `me`'s
     # perspective, it holds chunk (me - 1) at step 0 and chunk (me - 1 - i)
     # after receiving at step i, max-combining its own partial each hop.
-    acc = jnp.take(blocks, (me - 1) % s, axis=0)
+    #
+    # `me` is traced, so indexing chunk (me - 1 - i) directly would be a
+    # DYNAMIC gather per hop — S-1 of them, each materializing a [B, ...]
+    # copy from the [S, B, ...] buffer between the ppermutes (and on TPU,
+    # relayouting the buffer for every per-hop slice).  One pre-rotation
+    # puts the hop schedule in STATIC order instead:
+    # rolled[i] == blocks[(me - 1 - i) % s], so the loop body is a
+    # constant-index slice XLA folds into the combine.  The combine order
+    # per chunk is unchanged hop for hop, so results are bit-identical.
+    rolled = jnp.roll(blocks[::-1], me, axis=0)
+    acc = rolled[0]
     for i in range(1, s):
         acc = lax.ppermute(acc, axis_name, perm)
-        acc = jnp.maximum(acc, jnp.take(blocks, (me - 1 - i) % s, axis=0))
+        acc = jnp.maximum(acc, rolled[i])
     return acc
 
 

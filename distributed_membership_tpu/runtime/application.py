@@ -284,6 +284,14 @@ def main(argv=None) -> int:
         from distributed_membership_tpu.runtime.platform import (
             resolve_platform)
         resolve_platform(pin=args.platform)
+        # Multi-process mesh runtime: when DM_DIST_* is set (e.g. by
+        # scripts/multiproc_launch.py) join the coordinator BEFORE the
+        # first backend init so jax.devices() is the global pod device
+        # list and every mesh below spans all processes.  No-op when
+        # unset (runtime/distributed.py).
+        from distributed_membership_tpu.runtime.distributed import (
+            maybe_initialize)
+        maybe_initialize()
 
     if args.serve:
         # Control-plane posture (service/ package): the daemon owns the

@@ -67,6 +67,13 @@ _IDENTITY_EXCLUDE = frozenset(
      # twins), so a resume may change T or the pack width — the on-disk
      # snapshot is always the full-width carry at a segment boundary.
      "MEGA_TICKS", "MEGA_PACK",
+     # The batched exchange wire is trajectory-inert by contract too: the
+     # sender-aligned all_to_all delivers exactly the payloads the legacy
+     # per-shift rotations deliver (tests/test_exchange.py pins all four
+     # ring twins), and its double-buffered carry lane is flushed into
+     # the mailbox at every segment boundary, so the on-disk snapshot is
+     # always the legacy-shaped carry — a resume may switch modes.
+     "EXCHANGE_MODE",
      # Telemetry is trajectory-inert by contract (tests/test_timeline.py
      # pins bit-exactness on/off), so a resume may turn the flight
      # recorder on or move its output dir without invalidating the run.
@@ -200,6 +207,11 @@ def _atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
     os.replace(tmp, path)
 
 
+def _process_count() -> int:
+    from distributed_membership_tpu.runtime.distributed import process_count
+    return process_count()
+
+
 def _manifest_base(params: Params, seed: int, total: int,
                    collect_events: bool) -> dict:
     base = {
@@ -209,6 +221,13 @@ def _manifest_base(params: Params, seed: int, total: int,
         "backend": params.BACKEND,
         "total_time": int(total),
         "collect_events": bool(collect_events),
+        # Process topology (runtime/distributed.py): a multi-process run
+        # shards the SAME global mesh, so its per-tick math is identical
+        # to the single-process twin — but each process snapshots its own
+        # CHECKPOINT_DIR, and resuming one process's directory under a
+        # different topology would silently re-shard a carry the other
+        # processes still hold.  Refuse loudly instead.
+        "process_count": _process_count(),
     }
     if params.SCENARIO:
         # Content digest, not just the path (already in params_text): a
@@ -303,13 +322,16 @@ def _load_for_resume(ckpt_dir: str, base: dict, template_leaves: list):
                     f"{i} (truncated or from an incompatible code "
                     "version)")
             a = data[key]
-            t = np.asarray(tmpl)
-            if a.shape != t.shape or a.dtype != t.dtype:
+            # Shape/dtype only — never fetch the template's VALUE (in a
+            # multi-process run the global carry spans non-addressable
+            # devices and materializing it here would be both a crash
+            # and a pointless transfer).
+            if a.shape != tuple(tmpl.shape) or a.dtype != tmpl.dtype:
                 raise ValueError(
                     f"RESUME: carry leaf {i} shape/dtype mismatch "
                     f"({a.shape}/{a.dtype} on disk vs "
-                    f"{t.shape}/{t.dtype}) — checkpoint is from a "
-                    "different config")
+                    f"{tuple(tmpl.shape)}/{tmpl.dtype}) — checkpoint is "
+                    "from a different config")
             leaves.append(a)
         payload = {k[len("e_"):]: data[k] for k in data.files
                    if k.startswith("e_")}
@@ -607,9 +629,15 @@ def chunked_run(params: Params, plan, seed: int, total: int, *,
                                    drop_lo, drop_hi, *extra_inputs)
             # Per-segment flush: events leave the device NOW, so full-mode
             # device memory is O(every * N * M), and the carry lands on
-            # host for the snapshot.
-            carry = jax.tree.map(np.asarray, carry)
-            ev = jax.tree.map(np.asarray, ev)
+            # host for the snapshot.  to_host (not np.asarray): in a
+            # multi-process run the carry's node-sharded leaves are not
+            # fully addressable — every process gathers the same GLOBAL
+            # host value, so snapshots and log artifacts stay
+            # byte-identical across processes and to the 1-process twin.
+            from distributed_membership_tpu.runtime.distributed import (
+                to_host)
+            carry = to_host(carry)
+            ev = to_host(ev)
             t_sync = time.perf_counter()
             if telemetry_sink is not None:
                 ev, telem = ev

@@ -1,0 +1,139 @@
+"""Multi-process mesh runtime: one global mesh spanning K processes.
+
+The sharded backend's mesh (parallel/mesh.py) is built over
+``jax.devices()`` — in a single process that is the local chip set, but
+after :func:`jax.distributed.initialize` it is the GLOBAL device list
+across every process of the run, and the very same ``shard_map`` programs
+run unchanged with XLA moving the cross-process legs of each collective
+over DCN (or, on the CPU CI twin, gloo).  This module is the glue that
+makes that path reachable without perturbing single-process runs at all:
+
+* :func:`maybe_initialize` — idempotent ``jax.distributed.initialize``
+  driven entirely by ``DM_DIST_*`` environment variables, so the SAME CLI
+  invocation works single-process (vars unset: no-op) and as one rank of
+  a pod run (vars set by the operator or by
+  ``scripts/multiproc_launch.py``).  Must run before the first jax
+  backend init in the process.
+* :func:`to_host` — the multi-process-safe replacement for
+  ``jax.tree.map(np.asarray, ...)``: a jax.Array whose shards live on
+  other processes is not fully addressable and ``np.asarray`` raises, so
+  replicated leaves are read off the local shard and sharded leaves are
+  process-allgathered (every process gets the full global value, which
+  keeps every process's checkpoints and log artifacts byte-identical —
+  the property tests/test_exchange.py pins against the single-process
+  twin).
+* :func:`device_put_global` — the reverse seam: re-shard a host-global
+  carry onto the mesh for the next scan segment
+  (``jax.make_array_from_callback``; each process materializes only the
+  shards it owns).
+
+Environment contract (all unset = single-process, no-op):
+
+* ``DM_DIST_PROCS``     — total process count K (> 1 arms the init)
+* ``DM_DIST_PROC_ID``   — this process's rank in [0, K)
+* ``DM_DIST_COORD``     — coordinator address, e.g. ``localhost:9911``
+* ``DM_DIST_CPU_COLL``  — CPU collectives implementation (default
+  ``gloo``, the cross-process CPU backend jax ships)
+"""
+
+from __future__ import annotations
+
+import os
+
+PROCS_ENV = "DM_DIST_PROCS"
+PROC_ID_ENV = "DM_DIST_PROC_ID"
+COORD_ENV = "DM_DIST_COORD"
+CPU_COLL_ENV = "DM_DIST_CPU_COLL"
+
+_INITIALIZED = False
+
+
+def maybe_initialize() -> tuple:
+    """Initialize jax.distributed from ``DM_DIST_*`` if requested.
+
+    Returns ``(process_index, process_count)``.  Idempotent; a no-op
+    (returning ``(0, 1)``-shaped info from the env alone, without
+    touching jax) when ``DM_DIST_PROCS`` is unset or <= 1.  Call before
+    the first jax backend init (platform resolution included — the
+    coordinator handshake must precede device enumeration)."""
+    global _INITIALIZED
+    procs = int(os.environ.get(PROCS_ENV, "1") or 1)
+    if procs <= 1:
+        return 0, 1
+    pid = int(os.environ.get(PROC_ID_ENV, "0") or 0)
+    if _INITIALIZED:
+        return pid, procs
+    coord = os.environ.get(COORD_ENV)
+    if not coord:
+        raise ValueError(
+            f"{PROCS_ENV}={procs} requires {COORD_ENV} "
+            "(coordinator host:port shared by every process)")
+    import jax
+    # The CPU CI twin: cross-process collectives on the CPU backend need
+    # an explicit implementation; gloo is the one jax ships.  Harmless
+    # on TPU (the knob only affects the cpu backend).
+    jax.config.update("jax_cpu_collectives_implementation",
+                      os.environ.get(CPU_COLL_ENV, "gloo"))
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=procs, process_id=pid)
+    _INITIALIZED = True
+    return pid, procs
+
+
+def process_count() -> int:
+    """Global process count (1 until/without distributed init)."""
+    import jax
+    return int(jax.process_count())
+
+
+def process_index() -> int:
+    import jax
+    return int(jax.process_index())
+
+
+def _leaf_to_host(x):
+    import jax
+    import numpy as np
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(x)
+    if x.is_fully_replicated:
+        # Every shard holds the full value; read the first local one.
+        return np.asarray(x.addressable_data(0))
+    # Node-sharded leaf with remote shards: gather the global value onto
+    # every process (a collective — all processes must reach this
+    # together, which they do: the chunked driver's per-segment flush is
+    # the only caller and every process runs the same segment schedule).
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def to_host(tree):
+    """``jax.tree.map(np.asarray, tree)``, multi-process-safe."""
+    import jax
+    return jax.tree.map(_leaf_to_host, tree)
+
+
+def device_put_global(tree, mesh, spec_tree):
+    """Re-shard a host-global pytree onto ``mesh`` per ``spec_tree``.
+
+    Single-process this is a no-op (jit re-shards host arrays against
+    the in_specs on its own); multi-process, host numpy cannot express a
+    global array, so each leaf is rebuilt with
+    ``jax.make_array_from_callback`` — the callback hands XLA exactly
+    the shard slices this process's devices own."""
+    import jax
+    import numpy as np
+    if process_count() <= 1:
+        return tree
+    from jax.sharding import NamedSharding
+
+    def _put(a, spec):
+        if isinstance(a, jax.Array):
+            # First segment: the init runner's output is already the
+            # global device carry.
+            return a
+        a = np.asarray(a)
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(a.shape, sh,
+                                            lambda idx, _a=a: _a[idx])
+    return jax.tree.map(_put, tree, spec_tree)

@@ -154,6 +154,11 @@ def main() -> int:
                     help="with --census: run the whole-tick-fusion arm "
                          "instead (unfused vs fully-fused droppy step; "
                          "asserts the fused pass-count budget)")
+    ap.add_argument("--exchange", action="store_true",
+                    help="with --census: run the pod-scale exchange arm "
+                         "instead (sharded ring step through shard_map, "
+                         "legacy vs batched EXCHANGE_MODE; asserts the "
+                         "collective-launch budget)")
     ap.add_argument("--probe", action="store_true",
                     help="only check whether libtpu can serve the "
                          "abstract topology, then exit — callers give "
@@ -170,7 +175,8 @@ def main() -> int:
         # delegate before the TPU-support gate below.
         import hlo_census
         sys.argv = ([sys.argv[0], "--check"]
-                    + (["--fused"] if args.fused else []))
+                    + (["--fused"] if args.fused else [])
+                    + (["--exchange"] if args.exchange else []))
         return hlo_census.main()
 
     devices = tpu_topology_devices()

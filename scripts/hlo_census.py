@@ -41,7 +41,8 @@ def census_params(n: int, s: int, *, rng_mode: str = "batched",
                   probe_gather: str = "packed", drops: bool = False,
                   probe_io: str = "auto", telemetry: str = "off",
                   fused: bool = False, folded: bool | None = None,
-                  mega: int = 0, ck_every: int = 0):
+                  mega: int = 0, ck_every: int = 0,
+                  backend: str = "tpu_hash", exchange_mode: str = "-1"):
     """The ladder's 1M_s16 step config (profile_step.py defaults) at
     (n, s), with the round-6 lowering knobs exposed.  ``drops`` arms the
     msgdrop-class coin streams — the regime where the batched plan
@@ -75,7 +76,8 @@ def census_params(n: int, s: int, *, rng_mode: str = "batched",
         f"FUSED_PROBE: {f}\n{mega_keys}"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
         f"PROBE_IO: {probe_io}\nTELEMETRY: {telemetry}\n"
-        f"BACKEND: tpu_hash\n")
+        f"EXCHANGE_MODE: {exchange_mode}\n"
+        f"BACKEND: {backend}\n")
 
 
 def _walk_eqns(jaxpr, visit):
@@ -91,6 +93,47 @@ def _walk_eqns(jaxpr, visit):
                     _walk_eqns(sub.jaxpr, visit)
                 elif isinstance(sub, core.Jaxpr):
                     _walk_eqns(sub, visit)
+
+
+# The cross-shard launch classes the pod-scale exchange budget pins —
+# each eqn is one lowered collective launch (ICI/DCN round on hardware).
+_COLLECTIVES = ("ppermute", "all_to_all", "all_gather", "psum",
+                "psum_scatter")
+
+
+def _collective_counts(jaxpr) -> dict:
+    """Per-primitive EXECUTED-PATH collective-launch counts.
+
+    Differs from the flat :func:`_walk_eqns` sum in exactly one place:
+    a ``cond``/``switch`` eqn contributes the elementwise MAX over its
+    branches, because exactly one branch runs — the legacy gossip
+    exchange is a ``lax.switch`` over D block-shift permutations and
+    summing all D branches would overcount its per-tick launches D-fold.
+    Scan/while bodies still count once (the census is per-program, like
+    every other counter here)."""
+    from jax._src import core
+
+    total = dict.fromkeys(_COLLECTIVES, 0)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in total:
+            total[name] += 1
+            continue
+        subs = []
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            for sub in vals:
+                if isinstance(sub, core.ClosedJaxpr):
+                    subs.append(sub.jaxpr)
+                elif isinstance(sub, core.Jaxpr):
+                    subs.append(sub)
+        if not subs:
+            continue
+        per_branch = [_collective_counts(s) for s in subs]
+        for k in total:
+            agg = max if name == "cond" else sum
+            total[k] += agg(c[k] for c in per_branch)
+    return total
 
 
 def scenario_program(params, events):
@@ -184,6 +227,7 @@ def _count_program(jaxpr, n: int, s: int) -> dict:
                 counts["big_scatters"] += 1
 
     _walk_eqns(jaxpr, visit)
+    counts["collectives"] = _collective_counts(jaxpr)
     counts["n"] = n
     counts["s"] = s
     return counts
@@ -312,6 +356,85 @@ def scenario_census(n: int = 1 << 20, s: int = 16) -> dict:
     return out
 
 
+def exchange_census(n: int = 1 << 20, s: int = 16,
+                    shape: tuple = (8,)) -> dict:
+    """The pod-scale exchange structural contract at (n, s): ONE tick of
+    the sharded ring step, traced THROUGH ``shard_map`` over a concrete
+    ``shape`` mesh (default 1-D x8), legacy vs batched EXCHANGE_MODE.
+    Kernels stay off in both arms so the collective delta is isolated.
+
+    The budget tests/test_hlo_census.py pins: legacy's gossip fanout
+    costs ``fanout`` executed block-shift rounds per tick (a switch of
+    ppermutes per mesh axis — 2 launches per 1-D shift, payload + count);
+    the batched arm stacks every shift into destination buckets and
+    ships them as at most ONE ``all_to_all`` per tick (zero ppermutes),
+    with the gather/scatter/threefry/pallas counters unchanged — the
+    win is launch count, not a reshuffle of the compute program."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_membership_tpu.backends.tpu_hash_sharded import (
+        _get_init_runner, _get_segment_runner, sharded_config)
+    from distributed_membership_tpu.parallel.mesh import (make_mesh,
+                                                          make_mesh2d)
+
+    mesh = (make_mesh(shape[0]) if len(shape) == 1
+            else make_mesh2d(*shape))
+    n_local = n // mesh.size
+
+    def arm(mode):
+        params = census_params(n, s, backend="tpu_hash_sharded",
+                               exchange_mode=mode)
+        cfg = sharded_config(params, False, (0,), None, n_local)
+        # The production chunked program over a ONE-tick segment: the
+        # scan body (= the tick) counts once, and the xbuf wrap / agg
+        # re-init+reduce around it are identical across both arms so
+        # every budget delta isolates the exchange itself.
+        runner = _get_segment_runner(cfg, n_local, mesh, warm=True)
+        key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        state_g = jax.eval_shape(
+            _get_init_runner(cfg, n_local, mesh, warm=True), key_sds)
+        i32 = jnp.int32
+        sc = jax.ShapeDtypeStruct((), i32)
+        traced = runner.trace(
+            state_g,
+            jax.ShapeDtypeStruct((1,), i32),
+            jax.ShapeDtypeStruct((1, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n,), i32),
+            jax.ShapeDtypeStruct((n,), jnp.bool_), sc, sc, sc)
+        return _count_program(traced.jaxpr.jaxpr, n, s)
+
+    return {"n": n, "s": s, "shape": list(shape),
+            "axes": len(shape), "fanout": 3,
+            "legacy": arm("legacy"), "batched": arm("batched")}
+
+
+def check_exchange(out) -> bool:
+    """The --check predicate for one exchange_census result (shared with
+    tests/test_hlo_census.py so script and test cannot drift)."""
+    lg, bt = out["legacy"], out["batched"]
+    lgc, btc = lg["collectives"], bt["collectives"]
+    axes, fanout = out["axes"], out["fanout"]
+    return (
+        # Batched: every gossip shift rides ONE all_to_all round per
+        # tick on a flat axis tuple; zero per-shift ppermute rotations.
+        btc["ppermute"] == 0
+        and 1 <= btc["all_to_all"] <= axes
+        # Legacy: >= one executed ppermute launch per fanout shift per
+        # axis (1-D block_send is 2 per shift: payload + count rows).
+        and lgc["ppermute"] >= fanout * axes
+        and lgc["all_to_all"] == 0
+        # The collapse must not smuggle launches into other classes...
+        and btc["all_gather"] == lgc["all_gather"]
+        and btc["psum"] == lgc["psum"]
+        and btc["psum_scatter"] == lgc["psum_scatter"]
+        # ...nor restructure the compute program around them.
+        and bt["threefry_calls"] == lg["threefry_calls"]
+        and bt["big_gathers"] == lg["big_gathers"]
+        and bt["big_scatters"] == lg["big_scatters"]
+        and bt["pallas_calls"] == lg["pallas_calls"] == 0)
+
+
 def main() -> int:
     import argparse
 
@@ -333,6 +456,14 @@ def main() -> int:
                          "zero new [N]-class gathers/scatters, and "
                          "MEGA_TICKS=1 op-count-identical to the plain "
                          "program")
+    ap.add_argument("--exchange", action="store_true",
+                    help="print the pod-scale exchange census (sharded "
+                         "ring step through shard_map on an 8-device "
+                         "mesh, legacy vs batched EXCHANGE_MODE) "
+                         "instead; with --check, assert the collective-"
+                         "launch budget: batched <= one all_to_all per "
+                         "mesh axis, zero ppermutes, all other op "
+                         "classes unchanged")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero unless the default program shows "
                          "exactly one probe-leg gather and fewer "
@@ -340,6 +471,26 @@ def main() -> int:
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    if args.exchange:
+        # shard_map tracing needs a concrete mesh: force 8 virtual CPU
+        # devices BEFORE the first jax import (function-local imports
+        # keep jax unloaded until here; under pytest the conftest has
+        # already done this and the extra flag is a no-op).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        out = exchange_census(args.n, args.view)
+        print(json.dumps(out))
+        if args.check and not check_exchange(out):
+            print("exchange census regression: the batched arm must "
+                  "ship the whole gossip fanout as <= one all_to_all "
+                  "per mesh axis (no ppermutes) while leaving the "
+                  "gather/scatter/threefry/pallas counts unchanged",
+                  file=sys.stderr)
+            return 1
+        return 0
     if args.mega:
         out = mega_census(args.n, args.view, args.mega)
         print(json.dumps(out))

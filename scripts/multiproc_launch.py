@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Launch a K-process mesh run on one host (the pod-scale CI twin).
+
+Each process is a full ``python -m distributed_membership_tpu`` CLI
+invocation with ``DM_DIST_*`` set (runtime/distributed.py): process i
+joins the shared coordinator, jax builds ONE global mesh over all
+K x devices_per_proc devices, and the very same shard_map tick programs
+run with the cross-process legs of every collective on gloo (CPU) or
+DCN (TPU pods, where this launcher is replaced by the cluster's own
+per-host process manager and the same env vars).
+
+Every process computes identical GLOBAL host values at every segment
+boundary (runtime/distributed.to_host), so each writes its OWN complete
+artifact set — ``<out-root>/p{i}/dbg.log`` etc. are byte-identical
+across processes AND to a single-process run with the same total device
+count (tests/test_exchange.py pins both).  Checkpoints are per-process
+directories; kill/resume works by rerunning the same launcher command
+with ``--resume``.
+
+Examples::
+
+    python scripts/multiproc_launch.py testcases/singlefailure.conf \
+        --procs 2 --out-root /tmp/mp
+    python scripts/multiproc_launch.py big.conf --procs 2 \
+        --checkpoint-every 24 --resume --out-root /tmp/mp
+
+DM_* environment variables in the launcher's own environment (e.g.
+DM_CRASH_AT_TICK for fault-injection tests) are inherited by every
+child.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_commands(args, port: int):
+    """One (cmd, env, cwd) per process."""
+    conf = os.path.abspath(args.conf)
+    out_root = os.path.abspath(args.out_root)
+    jobs = []
+    for i in range(args.procs):
+        pdir = os.path.join(out_root, f"p{i}")
+        os.makedirs(pdir, exist_ok=True)
+        env = dict(os.environ)
+        env["DM_DIST_PROCS"] = str(args.procs)
+        env["DM_DIST_PROC_ID"] = str(i)
+        env["DM_DIST_COORD"] = f"localhost:{port}"
+        env["PYTHONPATH"] = (REPO_ROOT + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        if args.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_"
+                f"device_count={args.devices_per_proc}").strip()
+        cmd = [sys.executable, "-m", "distributed_membership_tpu", conf,
+               "--out-dir", pdir, "--platform", args.platform,
+               "--seed", str(args.seed)]
+        if args.backend:
+            cmd += ["--backend", args.backend]
+        if args.checkpoint_every:
+            cmd += ["--checkpoint-every", str(args.checkpoint_every),
+                    "--checkpoint-dir", os.path.join(pdir, "ckpt")]
+        if args.resume:
+            cmd += ["--resume"]
+        cmd += args.extra
+        jobs.append((cmd, env, pdir))
+    return jobs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("conf", help="run conf (same file for every process)")
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--out-root", required=True,
+                    help="per-process artifacts land in <out-root>/p{i}/")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--platform", default="cpu",
+                    help="cpu (default; gloo collectives) or tpu")
+    ap.add_argument("--devices-per-proc", type=int, default=1,
+                    help="virtual CPU devices per process (global mesh "
+                    "size = procs x this)")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-run wall clock limit in seconds")
+    ap.add_argument("extra", nargs="*",
+                    help="extra args forwarded to every CLI invocation")
+    args = ap.parse_args(argv)
+
+    port = _free_port()
+    jobs = build_commands(args, port)
+    procs = []
+    for i, (cmd, env, pdir) in enumerate(jobs):
+        logf = open(os.path.join(pdir, "launch.log"), "w")
+        procs.append((subprocess.Popen(cmd, env=env, cwd=pdir,
+                                       stdout=logf, stderr=logf), logf, i))
+        print(f"[multiproc] p{i} pid={procs[-1][0].pid} -> {pdir}")
+
+    rc = 0
+    try:
+        for p, logf, i in procs:
+            code = p.wait(timeout=args.timeout)
+            if code != 0:
+                print(f"[multiproc] p{i} exited {code} "
+                      f"(see p{i}/launch.log)", file=sys.stderr)
+                rc = rc or code
+    except subprocess.TimeoutExpired:
+        print("[multiproc] timeout — killing processes", file=sys.stderr)
+        rc = 124
+    finally:
+        for p, logf, _ in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            logf.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

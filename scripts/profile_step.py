@@ -42,7 +42,7 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
                rng_mode: str = "batched",
                probe_gather: str = "packed",
                fused_probe: bool = False, drops: bool = False,
-               mega_ticks: int = 0,
+               mega_ticks: int = 0, exchange_mode: str = "-1",
                trace_dir: str = "", runlog=None) -> dict:
     import random as _pyrandom
 
@@ -64,6 +64,15 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"DROP_MSG: 1\nMSG_DROP_PROB: 0.1\nDROP_START: {ticks // 6}\n"
         f"DROP_STOP: {ticks - ticks // 6}\n" if drops else
         "DROP_MSG: 0\nMSG_DROP_PROB: 0\n")
+    # --exchange-mode pins EXCHANGE_MODE and moves the run onto the
+    # SHARDED backend (the knob is tpu_hash_sharded only: the batched
+    # exchange replaces the per-shift cross-shard collectives).  The
+    # xbatch ladder rungs time it on one chip — a degenerate mesh, but
+    # the full batched program (bucket select, one all_to_all, next-head
+    # merge) with the PHASE_COLLECTIVE trace annotation scoping the
+    # collective leg in the banked perfetto trace.
+    sharded = exchange_mode != "-1"
+    backend = "tpu_hash_sharded" if sharded else "tpu_hash"
     text = (
         f"MAX_NNB: {n}\nSINGLE_FAILURE: 1\n{drop_keys}"
         f"VIEW_SIZE: {s}\nGOSSIP_LEN: {g}\nPROBES: {probes}\n"
@@ -74,9 +83,18 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         f"FUSED_PROBE: {int(fused_probe)}\n"
         f"PRNG_IMPL: {prng}\nSHIFT_SET: {shift_set}\n"
         f"RNG_MODE: {rng_mode}\nPROBE_GATHER: {probe_gather}\n"
-        f"BACKEND: tpu_hash\n")
+        f"BACKEND: {backend}\nEXCHANGE_MODE: {exchange_mode}\n")
     params = Params.from_text(text)
     plan = make_plan(params, _pyrandom.Random("app:0"))
+    if sharded:
+        from distributed_membership_tpu.backends.tpu_hash_sharded import (
+            bind_run_scan, resolve_mesh)
+        mesh = resolve_mesh(params)
+        scan = bind_run_scan(mesh)
+        mesh_fields = {"mesh_size": mesh.size}
+    else:
+        scan = run_scan
+        mesh_fields = {}
 
     # Checkpointed mode (the ladder's interrupted-rung resume path,
     # scripts/tpu_ladder.py): DM_CHECKPOINT_EVERY chunks both scans into
@@ -119,8 +137,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     if runlog is not None:
         runlog.event("compile", phase="start", **point)
     t0 = time.perf_counter()
-    final_state, _ = run_scan(warm_params, plan, seed=0,
-                              collect_events=False, total_time=ticks)
+    final_state, _ = scan(warm_params, plan, seed=0,
+                          collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     compile_wall = time.perf_counter() - t0
     if runlog is not None:
@@ -138,8 +156,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         os.makedirs(trace_dir, exist_ok=True)
         jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
-    final_state, _ = run_scan(timed_params, plan, seed=1,
-                              collect_events=False, total_time=ticks)
+    final_state, _ = scan(timed_params, plan, seed=1,
+                          collect_events=False, total_time=ticks)
     jax.block_until_ready(final_state)
     wall = time.perf_counter() - t0
     if trace_dir:
@@ -183,7 +201,10 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
     # [N, S] u32 plane size it says how many logical full-state passes
     # the compiler actually scheduled (the number kernel fusion reduces).
     measured = {}
-    if cost:
+    if cost and sharded:
+        measured = {"cost_analysis_note":
+                    "--cost is single-chip tpu_hash only"}
+    elif cost:
         # Opt-in (--cost): lower().compile() recompiles outside the jit
         # cache, roughly doubling the rung's wall time.
         try:
@@ -207,6 +228,8 @@ def time_point(n: int, s: int, ticks: int, exchange: str, fused: bool,
         "n": n, "s": s, "ticks": ticks, "exchange": cfg.exchange,
         "fused": fused, "fused_gossip": fused_gossip, "folded": folded,
         "fused_probe": fused_probe,
+        "backend": backend, "exchange_mode": exchange_mode,
+        **mesh_fields,
         "drop_prob": 0.1 if drops else 0,
         "prng": prng, "shift_set": shift_set,
         "rng_mode": rng_mode, "probe_gather": probe_gather,
@@ -270,6 +293,16 @@ def main() -> int:
                          "CHECKPOINT_EVERY to 4*T when "
                          "DM_CHECKPOINT_EVERY is unset or T does not "
                          "tile it")
+    ap.add_argument("--exchange-mode", default="-1",
+                    choices=["-1", "legacy", "batched"],
+                    help="EXCHANGE_MODE on the SHARDED backend (any "
+                         "explicit value moves the run onto "
+                         "tpu_hash_sharded over the device mesh): "
+                         "batched = one all_to_all per tick for the "
+                         "whole gossip fanout, overlap-consumed at the "
+                         "next tick's head; legacy = per-shift "
+                         "collectives.  -1 (default) keeps the "
+                         "single-chip tpu_hash run")
     ap.add_argument("--drops", default="off", choices=["off", "on"],
                     help="arm a mid-run 10%% drop window (the "
                          "masks-as-inputs composition rungs; rows carry "
@@ -313,6 +346,7 @@ def main() -> int:
                              fused_probe=args.fused_probe == "on",
                              drops=args.drops == "on",
                              mega_ticks=args.mega_ticks,
+                             exchange_mode=args.exchange_mode,
                              trace_dir=args.trace_dir, runlog=runlog)
             print(json.dumps(rec), flush=True)
     return 0

@@ -8,8 +8,12 @@ seed) and comparing final states bit-for-bit: the receive kernel under
 drops, the gossip kernel and the two-kernel composition drop-free, the
 masks-as-inputs gossip kernel under drops, the fused probe/agg
 traversal (natural + folded), the folded S=16 layout vs the
-natural one (droppy), and the T-tick megakernel scan with the packed
-carry at each banked block size (droppy, mega_t{T} families).
+natural one (droppy), the T-tick megakernel scan with the packed
+carry at each banked block size (droppy, mega_t{T} families), and the
+batched fanout exchange vs the per-shift legacy one on the sharded
+backend, natural + folded + riding the mega scan
+(sharded[_folded]_exchange_batched families — the EXCHANGE_MODE auto
+knob and the *_xbatch ladder rungs gate on them).
 Exit 0 = all identical.  The comparison is
 same-platform only: each variant vs the baseline on whatever backend
 resolve_platform selects.
@@ -30,7 +34,8 @@ sys.path.insert(0, REPO)
 def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
              n: int = 8192, s: int = 128, ticks: int = 60,
              folded: bool = False, sharded: bool = False,
-             fused_probe: bool = False, mega: int = 0):
+             fused_probe: bool = False, mega: int = 0,
+             exchange_mode: str = "-1"):
     """One full scan; returns the flattened final-state pytree.
 
     ``sharded`` runs the SAME config on BACKEND tpu_hash_sharded over a
@@ -62,6 +67,7 @@ def run_once(fused_recv: bool, fused_gossip: bool, drops: bool,
         f"EXCHANGE: ring\nFUSED_RECEIVE: {int(fused_recv)}\n"
         f"FUSED_GOSSIP: {int(fused_gossip)}\nFOLDED: {int(folded)}\n"
         f"FUSED_PROBE: {int(fused_probe)}\nBACKEND: {backend}\n"
+        f"EXCHANGE_MODE: {exchange_mode}\n"
         # MEGA_TICKS needs chunked segments to tile; K=4T matches the
         # default profile_step.py picks for its mega timing runs.
         + (f"CHECKPOINT_EVERY: {4 * mega}\nMEGA_TICKS: {mega}\n"
@@ -219,6 +225,26 @@ def main() -> int:
             sh_mg_d = run_once_s(False, False, True, n=args.n,
                                  ticks=args.ticks, mega=t_m)
             checks[f"sharded_mega_t{t_m}"] = diff(sh_base_d, sh_mg_d)
+        # Batched fanout exchange (ops/exchange, EXCHANGE_MODE batched)
+        # vs the per-shift legacy exchange, droppy.  EXPLICIT legacy on
+        # the reference side: the default '-1' auto-resolves batched
+        # once this very family is banked clean, which would turn the
+        # check into batched-vs-batched on the next pass.  Gates the
+        # *_xbatch ladder rungs and the runtime auto knob
+        # (sharded_exchange_batched).
+        sh_leg_d = run_once_s(False, False, True, n=args.n,
+                              ticks=args.ticks, exchange_mode="legacy")
+        sh_xb_d = run_once_s(False, False, True, n=args.n,
+                             ticks=args.ticks, exchange_mode="batched")
+        checks["sharded_exchange_batched"] = diff(sh_leg_d, sh_xb_d)
+        # ... and riding the T=8 megakernel scan (the xbatch_mega8
+        # rung's program: the xbuf carry crosses mega-block boundaries
+        # packed, a different composition than either alone).
+        sh_xbm_d = run_once_s(False, False, True, n=args.n,
+                              ticks=args.ticks, mega=8,
+                              exchange_mode="batched")
+        checks["sharded_exchange_batched_mega_t8"] = diff(sh_leg_d,
+                                                          sh_xbm_d)
         sh_base = run_once_s(False, False, False, n=args.n,
                              ticks=args.ticks)
         sh_goss = run_once_s(False, True, False, n=args.n,
@@ -251,6 +277,24 @@ def main() -> int:
         checks[f"sharded_folded_fused_probe_s{s_f}"] = {
             k: int((shf_f[k].reshape(-1) != shfp_f[k].reshape(-1)).sum())
             for k in shf_f}
+        if s_f == 16:
+            # Batched exchange on the FOLDED planes (a different bucket
+            # select/merge than the natural layout).  S=16 only: the
+            # runtime auto knob consults the exact family name
+            # 'sharded_folded_exchange_batched' (no fold-factor suffix)
+            # and S=16 is the geometry every folded ladder rung runs.
+            # Explicit legacy reference for the same non-vacuity reason
+            # as the natural pair above.
+            shxl_f = run_once_s(False, False, True, n=args.n, s=s_f,
+                                ticks=args.ticks, folded=True,
+                                exchange_mode="legacy")
+            shxb_f = run_once_s(False, False, True, n=args.n, s=s_f,
+                                ticks=args.ticks, folded=True,
+                                exchange_mode="batched")
+            checks["sharded_folded_exchange_batched"] = {
+                k: int((shxl_f[k].reshape(-1)
+                        != shxb_f[k].reshape(-1)).sum())
+                for k in shxl_f}
 
     mism = {name: {k: v for k, v in d.items() if v}
             for name, d in checks.items()}

@@ -217,9 +217,21 @@ LADDER = [
     ("1M_s64",           1 << 20,  64,  60, "off",    900),
     ("1M_s128",          1 << 20, 128,  40, "off",    900),
     ("1M_s128_fboth",    1 << 20, 128,  40, "both",   900),
-    # Last: gates no timing rungs (it unlocks the sharded backend's auto
-    # knobs at runtime), so all perf evidence lands first.
+    # Late: single-chip perf evidence lands first.  Besides unlocking
+    # the sharded backend's auto knobs at runtime, this banks the
+    # exchange families the xbatch rungs below gate on — they sit AFTER
+    # it so one served pass can land verdict + timing.
     SHARDED_CORR_RUNG,
+    # Pod-scale exchange (ops/exchange): EXCHANGE_MODE batched ships
+    # the whole gossip fanout as ONE all_to_all per tick on the sharded
+    # backend (census-pinned 6 ppermutes -> 1 collective at [1M,16]),
+    # consumed at the NEXT tick's head (comm/compute overlap) — alone
+    # and riding the T=8 megakernel scan.  Gated fail-closed on the
+    # sharded_exchange_batched* families; one chip times the batched
+    # program's local legs (bucket select/merge) — the cross-chip DCN
+    # win needs a pod and is modeled in PERF.md instead.
+    ("1M_s16_xbatch",       1 << 20, 16, 60, "xbatch", 1200),
+    ("1M_s16_xbatch_mega8", 1 << 20, 16, 64, "xbatch_mega8", 1200),
 ]
 
 
@@ -351,10 +363,18 @@ def run_rung(name: str, n: int, s: int, ticks: int, fused: str,
         # T-tick megakernel scan; T rides the mode-string suffix.
         mega_t = (int(fused.rsplit("mega", 1)[1])
                   if fused.startswith("folded_mega") else 0)
+        # xbatch modes run the PLAIN natural program on the sharded
+        # backend with the batched exchange (no folded/fused kernels —
+        # the delta vs 1M_s16 isolates the exchange lowering);
+        # xbatch_mega{T} adds only the megakernel scan.
+        xbatch = fused.startswith("xbatch")
+        xbatch_mega = (int(fused.rsplit("mega", 1)[1])
+                       if fused.startswith("xbatch_mega") else 0)
         cmd = [sys.executable,
                os.path.join(REPO, "scripts", "profile_step.py"),
                "--n", str(n), "--view", str(s), "--ticks", str(ticks),
-               "--mega-ticks", str(mega_t),
+               "--mega-ticks", str(mega_t or xbatch_mega),
+               "--exchange-mode", "batched" if xbatch else "-1",
                "--fused",
                "on" if fused in ("recv", "both", "folded_fboth",
                                  "folded_fboth_drop", "folded_fall")
@@ -508,6 +528,22 @@ def _rung_gated(rung, corr) -> bool:
     mismatch detail; a detail-free failure gates every non-natural rung
     (fail closed)."""
     mode, view = rung[4], rung[2]
+    if mode.startswith("xbatch"):
+        # Batched-exchange timing rungs gate on the exchange families
+        # being banked AND clean — fail closed even with NO verdict at
+        # all (unlike the natural rungs below, which carry no lowering
+        # a missing verdict could miscompile): the rungs sit after the
+        # sharded_correctness rung precisely so a served pass lands the
+        # verdict first.
+        mism = (corr or {}).get("mismatched_elements", {})
+        keys = ("sharded_exchange_batched",)
+        if mode.startswith("xbatch_mega"):
+            t_m = int(mode.rsplit("mega", 1)[1])
+            keys += (f"sharded_exchange_batched_mega_t{t_m}",
+                     f"sharded_mega_t{t_m}")
+        if not all(k in mism for k in keys):
+            return True
+        return any(bool(mism.get(k)) for k in keys)
     # 'rbg' swaps the key-stream impl, 'sw16' the shift-draw
     # distribution, and 'rngplan'/'onegather' the RNG/gather lowering on
     # the plain jnp step — no Pallas kernel in the program, so no
@@ -610,7 +646,10 @@ ARM_FAMILIES = {
                             "sharded_folded_s64",
                             "sharded_folded_fused_s64",
                             "sharded_folded_fused_probe_s64",
-                            "sharded_mega_t8", "sharded_mega_t32"),
+                            "sharded_mega_t8", "sharded_mega_t32",
+                            "sharded_exchange_batched",
+                            "sharded_exchange_batched_mega_t8",
+                            "sharded_folded_exchange_batched"),
 }
 
 

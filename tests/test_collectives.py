@@ -2,6 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax import lax
 from jax.sharding import PartitionSpec as P
 from distributed_membership_tpu.parallel import shard_map
 
@@ -41,6 +42,80 @@ def test_ring_reduce_scatter_max_matches_pmax(mesh8):
     # Reduce-scatter gives each shard its own rows.
     got = np.asarray(rs).reshape(n, e)
     np.testing.assert_array_equal(got, expected)
+
+
+def _legacy_ring_reduce_scatter_max(x, axis_name):
+    """Verbatim pre-PR-13 implementation — per-hop DYNAMIC chunk takes.
+
+    Kept as the bit-exactness reference for the static-schedule rewrite:
+    the production version pre-rotates the chunk buffer once so every
+    hop's slice index is static, but must combine the same chunks in the
+    same order hop for hop."""
+    s = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    if s == 1:
+        return x
+    b = x.shape[0] // s
+    blocks = x.reshape(s, b, *x.shape[1:])
+    perm = [(j, (j + 1) % s) for j in range(s)]
+    acc = jnp.take(blocks, (me - 1) % s, axis=0)
+    for i in range(1, s):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = jnp.maximum(acc, jnp.take(blocks, (me - 1 - i) % s, axis=0))
+    return acc
+
+
+def _count_eqns(jaxpr, names):
+    from jax._src import core
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in names:
+            n += 1
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if isinstance(sub, core.ClosedJaxpr):
+                    n += _count_eqns(sub.jaxpr, names)
+                elif isinstance(sub, core.Jaxpr):
+                    n += _count_eqns(sub, names)
+    return n
+
+
+def test_ring_reduce_scatter_max_bit_exact_vs_legacy(mesh8):
+    """The static-schedule rewrite must be BIT-identical to the per-hop
+    dynamic-take legacy (same chunks, same combine order), while tracing
+    to a bounded number of dynamic-index ops: the legacy program slices
+    the chunk buffer at a traced index once per hop (S of them), the
+    rewrite pays one pre-rotation (a roll: two dynamic slices) total."""
+    n, e = 32, 12
+    key = jax.random.PRNGKey(42)
+    xi = jax.random.randint(key, (8, n, e), -1000, 1000)
+    xf = jax.random.normal(key, (8, n, e), jnp.float32)
+
+    def run(fn, parts):
+        def f(part):
+            return fn(part[0], NODE_AXIS)[None]
+        return jax.jit(shard_map(
+            f, mesh=mesh8, in_specs=P(NODE_AXIS, None, None),
+            out_specs=P(NODE_AXIS, None, None)))(parts)
+
+    for x in (xi, xf):
+        new = run(ring_reduce_scatter_max, x)
+        old = run(_legacy_ring_reduce_scatter_max, x)
+        np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+    def traced(fn):
+        def f(part):
+            return fn(part[0], NODE_AXIS)[None]
+        return jax.jit(shard_map(
+            f, mesh=mesh8, in_specs=P(NODE_AXIS, None, None),
+            out_specs=P(NODE_AXIS, None, None))).trace(
+                jax.ShapeDtypeStruct(xi.shape, xi.dtype)).jaxpr.jaxpr
+
+    dyn = ("dynamic_slice", "gather")
+    n_new = _count_eqns(traced(ring_reduce_scatter_max), dyn)
+    n_old = _count_eqns(traced(_legacy_ring_reduce_scatter_max), dyn)
+    assert n_new <= 2, n_new       # the single roll's two dynamic slices
+    assert n_old >= 8, n_old       # one traced-index take per chunk
 
 
 def test_reduce_scatter_sum_and_gather(mesh8):

@@ -1,0 +1,306 @@
+"""Pod-scale exchange contracts (EXCHANGE_MODE + ops/exchange +
+runtime/distributed + scripts/multiproc_launch.py).
+
+Four layers:
+
+* **Batched == legacy, bit-exactly** — ``EXCHANGE_MODE: batched`` (the
+  whole gossip fanout bucketed per destination shard and shipped as ONE
+  ``all_to_all`` per tick, consumed at the NEXT tick's head) reproduces
+  the legacy per-shift ppermute exchange exactly: trajectory, detection
+  summary, every telemetry series — droppy + chunked on the natural and
+  folded sharded twins, on 2x4 / 4x2 / 2x2x2 torus meshes, and under a
+  partition + crash + restart + link_flake chaos scenario.
+* **Kill/resume** — a batched run killed mid-flight resumes from the
+  legacy-shaped snapshot (the xbuf lives strictly inside the scan) to
+  the uninterrupted per-tick legacy trajectory; EXCHANGE_MODE stays out
+  of the checkpoint identity like MEGA_TICKS.
+* **Multi-process runtime** — a REAL 2-process CPU run via
+  scripts/multiproc_launch.py (jax.distributed + gloo collectives, one
+  global mesh) writes byte-identical dbg.log/stats.log in every process
+  AND matches the single-process twin with the same total device count;
+  killed mid-run, it resumes to the same bytes.
+* **Config contract** — EXCHANGE_MODE validation and its exclusion from
+  the resume identity.
+"""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.runtime import checkpoint as ck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(conf: str, seed: int = 3):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_backend("tpu_hash_sharded")(Params.from_text(conf),
+                                               seed=seed)
+
+
+def _assert_same_run(r0, r1):
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    f0, f1 = r0.extra["final_state"], r1.extra["final_state"]
+    for name in ("view", "view_ts", "mail", "self_hb"):
+        np.testing.assert_array_equal(np.asarray(getattr(f0, name)),
+                                      np.asarray(getattr(f1, name)),
+                                      err_msg=name)
+    tl0, tl1 = (r0.extra.get("timeline"), r1.extra.get("timeline"))
+    if tl0 is not None:
+        assert set(tl0) == set(tl1)
+        for k in tl0:
+            np.testing.assert_array_equal(np.asarray(tl0[k]),
+                                          np.asarray(tl1[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# Batched == legacy: droppy + full hist telemetry + chunked, both twins.
+
+
+_X_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+    "DROP_START: 10\nDROP_STOP: 50\nGOSSIP_LEN: {g}\nPROBES: {p}\n"
+    "FANOUT: 3\nTFAIL: 16\nTREMOVE: 64\nTOTAL_TIME: 60\nFAIL_TIME: 30\n"
+    "VIEW_SIZE: {s}\nJOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "TELEMETRY: hist\nCHECKPOINT_EVERY: 24\n"
+    "BACKEND: tpu_hash_sharded\n")
+
+
+@pytest.mark.parametrize("extra", [
+    "",
+    pytest.param("FOLDED: 1\n", marks=pytest.mark.slow),
+], ids=["natural", "folded"])
+def test_batched_bit_exact_droppy_chunked(extra):
+    """EXCHANGE_MODE: batched == legacy on the default 8-device 1-D
+    mesh, bit-exactly, in the hardest composition tier-1 carries:
+    message drops, the full hist telemetry tree, and the chunked
+    segment runner (the xbuf flushes at every segment boundary — the
+    per-segment flush must equal the whole-run deferral)."""
+    n = 512 if extra else 256
+    conf = _X_CONF.format(n=n, s=16, g=8, p=2) + extra
+    _assert_same_run(_run(conf + "EXCHANGE_MODE: legacy\n"),
+                     _run(conf + "EXCHANGE_MODE: batched\n"))
+
+
+# The 2-D/3-D torus meshes: the batched bucket-select and receiver
+# alignment run on the FLAT outer-major shard index, so one all_to_all
+# over the axis TUPLE must reproduce the per-axis decomposed block
+# shifts.  2x4 runs tier-1 (the torus path is new coverage); its
+# transpose and the 3-axis mesh ride the slow tier.
+@pytest.mark.parametrize("shape", [
+    "2x4",
+    pytest.param("4x2", marks=pytest.mark.slow),
+    pytest.param("2x2x2", marks=pytest.mark.slow),
+])
+def test_batched_bit_exact_torus_meshes(shape):
+    conf = (_X_CONF.format(n=512, s=16, g=8, p=2)
+            + f"MESH_SHAPE: {shape}\n")
+    _assert_same_run(_run(conf + "EXCHANGE_MODE: legacy\n"),
+                     _run(conf + "EXCHANGE_MODE: batched\n"))
+
+
+# ---------------------------------------------------------------------------
+# Chaos composition: the up/down wipe must chase removals into the
+# in-flight xbuf (wipe-after-merge == wipe-the-buffer, because the wipe
+# plane distributes over the max/sum merges).
+
+
+_CHAOS_CONF = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "GOSSIP_LEN: 8\nPROBES: 2\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 64\n"
+    "TOTAL_TIME: 170\nVIEW_SIZE: 16\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+    "EXCHANGE: ring\nTELEMETRY: scalars\nCHECKPOINT_EVERY: 40\n"
+    "BACKEND: tpu_hash_sharded\n")
+
+
+@pytest.mark.slow
+def test_batched_chaos_bit_exact(tmp_path):
+    """Partition + crash + restart + link_flake under batched == legacy,
+    with the restart proven non-vacuous (a silently inert scenario would
+    make the bit-equality meaningless)."""
+    import json
+
+    n = 256
+    events = [
+        {"kind": "partition", "start": 20, "stop": 80,
+         "groups": [[0, n // 2], [n // 2, n]]},
+        {"kind": "crash", "time": 30, "range": [4, 8]},
+        {"kind": "restart", "time": 100, "range": [4, 8]},
+        {"kind": "link_flake", "start": 110, "stop": 150,
+         "src": [0, n // 2], "dst": [n // 2, n], "drop_prob": 0.2},
+    ]
+    spath = tmp_path / "chaos.json"
+    spath.write_text(json.dumps({"name": "chaos", "events": events}))
+    conf = _CHAOS_CONF.format(n=n) + f"SCENARIO: {spath}\n"
+    r0 = _run(conf + "EXCHANGE_MODE: legacy\n", seed=5)
+    r1 = _run(conf + "EXCHANGE_MODE: batched\n", seed=5)
+    assert (r0.extra["detection_summary"]
+            == r1.extra["detection_summary"])
+    assert r0.extra["scenario_report"] == r1.extra["scenario_report"]
+    np.testing.assert_array_equal(r0.sent, r1.sent)
+    np.testing.assert_array_equal(r0.recv, r1.recv)
+    rep = r0.extra["scenario_report"]
+    assert rep["partitions"][0]["removals_during"] > 0
+    assert rep["restarts"][0]["rejoined"] is True
+
+
+# ---------------------------------------------------------------------------
+# Kill/resume: the xbuf lives strictly inside the scan, so snapshots
+# stay legacy-shaped and EXCHANGE_MODE is trajectory-inert.
+
+
+_KR_CONF = (
+    "MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 1\nMSG_DROP_PROB: 0.1\n"
+    "DROP_START: 30\nDROP_STOP: 120\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
+    "PROBES: 2\nFANOUT: 3\nTFAIL: 16\nTREMOVE: 40\nTOTAL_TIME: 200\n"
+    "FAIL_TIME: 100\nJOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "BACKEND: tpu_hash_sharded\n")
+
+
+def test_exchange_kill_resume_bit_exact(tmp_path, monkeypatch):
+    """A batched run killed mid-flight (inside the drop window, before
+    FAIL_TIME) resumes — under LEGACY mode, proving the snapshot
+    carries no xbuf and the knob is resume-legal either way — to the
+    uninterrupted per-tick legacy trajectory."""
+    ref = _run(_KR_CONF + "EXCHANGE_MODE: legacy\n")
+
+    ckdir = tmp_path / "ck"
+    ck_keys = f"CHECKPOINT_EVERY: 40\nCHECKPOINT_DIR: {ckdir}\n"
+    monkeypatch.setenv(ck.CRASH_ENV, "50")
+    with pytest.raises(RuntimeError, match="injected crash"):
+        _run(_KR_CONF + ck_keys + "EXCHANGE_MODE: batched\n")
+    assert ck.manifest_tick(str(ckdir)) == 80
+
+    monkeypatch.delenv(ck.CRASH_ENV)
+    r = _run(_KR_CONF + ck_keys + "EXCHANGE_MODE: legacy\nRESUME: 1\n")
+    _assert_same_run(ref, r)
+
+
+@pytest.mark.quick
+def test_exchange_mode_is_trajectory_inert_in_identity():
+    """EXCHANGE_MODE stays out of the manifest identity (like
+    MEGA_TICKS): batched vs legacy is a lowering choice, never a
+    different run."""
+    base = _KR_CONF + "CHECKPOINT_EVERY: 40\n"
+    ids = {ck.params_identity(Params.from_text(base + x))
+           for x in ("", "EXCHANGE_MODE: legacy\n",
+                     "EXCHANGE_MODE: batched\n")}
+    assert len(ids) == 1
+
+
+@pytest.mark.quick
+def test_exchange_mode_validation():
+    with pytest.raises(ValueError, match="EXCHANGE_MODE"):
+        Params.from_text(_KR_CONF + "EXCHANGE_MODE: sideways\n")
+    with pytest.raises(ValueError, match="ring"):
+        Params.from_text(
+            _KR_CONF.replace("EXCHANGE: ring", "EXCHANGE: scatter")
+            + "EXCHANGE_MODE: batched\n")
+
+
+# ---------------------------------------------------------------------------
+# Multi-process runtime: the launcher's 2-process CPU run is the pod
+# twin CI can actually execute.
+
+
+_MP_CONF = (
+    "MAX_NNB: 64\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\nFANOUT: 3\nTFAIL: 16\n"
+    "TREMOVE: 40\nTOTAL_TIME: 40\nFAIL_TIME: 20\nJOIN_MODE: warm\n"
+    "EVENT_MODE: agg\nEXCHANGE: ring\nEXCHANGE_MODE: batched\n"
+    "BACKEND: tpu_hash_sharded\n")
+
+
+def _launch(conf_path, out_root, *extra_args, env_extra=None,
+            timeout=420):
+    env = dict(os.environ)
+    # The children build their OWN device topology (1 virtual CPU device
+    # per process by default): the pytest session's 8-device XLA_FLAGS
+    # and any ambient DM_DIST_* must not leak through.
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("DM_DIST_"):
+            env.pop(k)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "multiproc_launch.py"),
+         str(conf_path), "--out-root", str(out_root),
+         "--timeout", str(timeout - 20), *extra_args],
+        env=env, cwd=REPO, timeout=timeout, capture_output=True,
+        text=True)
+
+
+def _read(out_root, proc, name):
+    path = os.path.join(str(out_root), f"p{proc}", name)
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def test_multiproc_launcher_round_trip(tmp_path):
+    """A REAL 2-process run (jax.distributed + gloo, one global
+    2-device mesh, batched exchange crossing the process boundary):
+    both processes write byte-identical dbg.log/stats.log, and those
+    bytes equal the single-process twin with the same total device
+    count — the multi-process runtime is a deployment choice, not a
+    different simulation."""
+    conf = tmp_path / "mp.conf"
+    conf.write_text(_MP_CONF)
+
+    r2 = _launch(conf, tmp_path / "mp2", "--procs", "2")
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    for name in ("dbg.log", "stats.log"):
+        assert _read(tmp_path / "mp2", 0, name) == _read(
+            tmp_path / "mp2", 1, name), name
+
+    r1 = _launch(conf, tmp_path / "sp", "--procs", "1",
+                 "--devices-per-proc", "2")
+    assert r1.returncode == 0, (r1.stdout, r1.stderr)
+    for name in ("dbg.log", "stats.log"):
+        assert _read(tmp_path / "mp2", 0, name) == _read(
+            tmp_path / "sp", 0, name), name
+
+
+@pytest.mark.slow
+def test_multiproc_kill_resume_bit_exact(tmp_path):
+    """Both processes crash mid-run (checkpointed), rerunning the same
+    launcher command with --resume completes the run, and the resumed
+    artifacts are byte-identical to an uninterrupted reference — the
+    multi-process checkpoint identity (manifest process_count included)
+    round-trips."""
+    conf = tmp_path / "mp.conf"
+    conf.write_text(_MP_CONF)
+    ck_args = ("--procs", "2", "--checkpoint-every", "20")
+
+    ref = _launch(conf, tmp_path / "ref", *ck_args)
+    assert ref.returncode == 0, (ref.stdout, ref.stderr)
+
+    # The injection fires at the first segment-start boundary >= the
+    # crash tick: crash_at=10 -> boundary 20, with the tick-20 snapshot
+    # already durable in both processes.
+    crashed = _launch(conf, tmp_path / "kr", *ck_args,
+                      env_extra={ck.CRASH_ENV: "10"})
+    assert crashed.returncode != 0
+
+    resumed = _launch(conf, tmp_path / "kr", *ck_args, "--resume")
+    assert resumed.returncode == 0, (resumed.stdout, resumed.stderr)
+    for name in ("dbg.log", "stats.log"):
+        assert _read(tmp_path / "kr", 0, name) == _read(
+            tmp_path / "ref", 0, name), name
+        assert _read(tmp_path / "kr", 0, name) == _read(
+            tmp_path / "kr", 1, name), name
+
+    import json
+    with open(os.path.join(str(tmp_path), "kr", "p0", "ckpt",
+                           "MANIFEST.json")) as fh:
+        assert json.load(fh)["process_count"] == 2

@@ -235,3 +235,27 @@ def test_census_exact_mode_single_gather():
         65536, 16, probe_io="exact", probe_gather="split",
         rng_mode="scattered"))
     assert c_split["big_gathers"] == 2, c_split
+
+
+@pytest.mark.quick
+def test_exchange_census_collective_budget_at_1m_s16():
+    """Pod-scale exchange structural contract at the [1M, 16] north-star
+    geometry (scripts/hlo_census.py --exchange): the batched arm must
+    ship the whole gossip fanout as at most ONE ``all_to_all`` per mesh
+    axis (zero per-shift ppermutes), while the legacy arm pays one
+    executed block-shift switch per fanout shift, and the
+    gather/scatter/threefry/pallas counters stay IDENTICAL across arms —
+    the optimization collapses collective launches, it never
+    restructures the compute program around them.  Counts come from the
+    traced one-tick segment program THROUGH shard_map on the 8-device
+    mesh (executed-path counting: a switch contributes the max over its
+    branches, not the sum)."""
+    for shape in ((8,), (2, 4)):
+        out = hlo_census.exchange_census(n=1 << 20, s=16, shape=shape)
+        assert hlo_census.check_exchange(out), out
+    # 1-D exact pins: FANOUT=3 block shifts x (payload, count) tensors
+    # = 6 executed ppermute launches legacy, ONE all_to_all batched.
+    out = hlo_census.exchange_census(n=1 << 20, s=16, shape=(8,))
+    assert out["legacy"]["collectives"]["ppermute"] == 6, out["legacy"]
+    assert out["batched"]["collectives"]["ppermute"] == 0, out["batched"]
+    assert out["batched"]["collectives"]["all_to_all"] == 1, out["batched"]

@@ -64,6 +64,37 @@ def test_make_row_keys_mega_rows_per_block_size():
 
 
 @pytest.mark.quick
+def test_make_row_keys_multiprocess_rows_per_topology():
+    """Pod-scale rows key by (rung, P): a truthy knobs["procs"] lifts
+    the process count into the rung (rung:p{P}) so single-process and
+    multi-process trends are separate --check histories — the
+    cross-process collective legs dominate at P > 1 and a healthy P=1
+    history must never absorb a pod-run collapse."""
+    def row(procs, value):
+        knobs = {"ticks": 400}
+        if procs > 1:
+            knobs["procs"] = procs
+        return perfdb.make_row(
+            "bench:live:hash:exchange", metric="exchange_speedup_pct",
+            value=value, n=65536, s=16, backend="tpu_hash_sharded",
+            platform="cpu", knobs=knobs)
+
+    r1, r2 = row(1, 10.0), row(2, 12.0)
+    assert r1["rung"] == "bench:live:hash:exchange"
+    assert r2["rung"] == "bench:live:hash:exchange:p2"
+    assert r1["key"] != r2["key"]
+    hist = [row(1, 10.0), row(2, 12.0), row(1, 9.5), row(2, 2.0)]
+    bad = perfdb.check(hist)
+    assert (len(bad) == 1
+            and bad[0]["rung"] == "bench:live:hash:exchange:p2")
+    # Composition with the mega lift: both knobs present -> both
+    # suffixes, T first (the mega lift runs first), P second.
+    both = perfdb.make_row("r", metric="m", value=1.0,
+                           knobs={"mega_ticks": 8, "procs": 2})
+    assert both["rung"] == "r:t8:p2"
+
+
+@pytest.mark.quick
 def test_append_is_idempotent_and_torn_tolerant(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     rows = [perfdb.make_row("r", metric="m", value=v, source="s",
