@@ -147,7 +147,13 @@ def _bench_rng_micro(cfg) -> dict:
     return out
 
 
-def _service_client_main(port: int, n: int) -> int:
+def _hostport(spec: str, default_host: str = "127.0.0.1"):
+    """``"8080"`` or ``"host:8080"`` -> (host, port)."""
+    host, _, p = spec.rpartition(":")
+    return (host or default_host, int(p))
+
+
+def _service_client_main(port: int, n: int, connect: str = "") -> int:
     """Hidden child mode (``--service-client``) for _bench_service.
 
     Hammers the daemon from a SEPARATE process — real clients do not
@@ -155,18 +161,30 @@ def _service_client_main(port: int, n: int) -> int:
     be billed to the tick loop's GIL — with BENCH_SERVICE_CLIENTS
     paced keep-alive workers alternating ``/v1/census`` and
     ``/v1/member/<id>``.  The pacing (BENCH_SERVICE_QPS total offered
-    load, default 800) models polling dashboards rather than a
-    closed-loop saturation attack: unthrottled in-process loops measure
-    only how hard eight spinning clients can starve a shared host, not
-    the serving overhead the ISSUE bounds (>= 500 q/s sustained with
-    <= 5% slowdown).  Runs until stdin yields a line (or EOF), then
-    prints one JSON line ``{"queries", "seconds"}``.
+    load, default 800; 0 = unthrottled closed loop, for pricing the
+    replica pool's ceiling rather than a dashboard workload) models
+    polling dashboards rather than a closed-loop saturation attack:
+    unthrottled in-process loops measure only how hard eight spinning
+    clients can starve a shared host, not the serving overhead the
+    ISSUE bounds (>= 500 q/s sustained with <= 5% slowdown).
+
+    Targets: ``--connect host:port[,host:port...]`` (off-box service
+    bench) or BENCH_SERVICE_PORTS (comma list, the replica pool)
+    override the single local port; each client pins to one target, so
+    K clients spread over the pool.  A dedicated depth-1 sampler
+    connection measures request latency OUTSIDE the pipelined firehose
+    (a pipelined stream's per-reply time is queueing, not service
+    time) and polls ``/healthz`` for answer staleness (engine tick
+    minus served snapshot tick).  Runs until stdin yields a line (or
+    EOF), then prints one JSON line ``{"queries", "seconds",
+    "p50_ms", "p99_ms", "staleness_mean_ticks", "staleness_max_ticks"}``.
     """
     import socket
     import threading
 
     clients = int(os.environ.get("BENCH_SERVICE_CLIENTS", "8"))
     target = float(os.environ.get("BENCH_SERVICE_QPS", "800"))
+    throttled = target > 0
     interval = clients / max(target, 1e-9)
     stop = threading.Event()
     counts = [0] * clients
@@ -177,6 +195,13 @@ def _service_client_main(port: int, n: int) -> int:
     # ``/v1/runs/<id>`` mounts and each client sticks to one, so K
     # clients spread across the fleet's runs.
     prefixes = os.environ.get("BENCH_SERVICE_PREFIX", "").split(",")
+    raw_ports = os.environ.get("BENCH_SERVICE_PORTS", "")
+    if connect:
+        targets = [_hostport(x) for x in connect.split(",") if x]
+    elif raw_ports:
+        targets = [_hostport(x) for x in raw_ports.split(",") if x]
+    else:
+        targets = [("127.0.0.1", port)]
 
     def worker(i):
         # Raw sockets, prebuilt request bytes, HTTP/1.1 pipelining
@@ -186,6 +211,7 @@ def _service_client_main(port: int, n: int) -> int:
         # BaseHTTPRequestHandler reads requests from a buffered rfile,
         # so pipelined requests are answered in order.
         pref = prefixes[i % len(prefixes)]
+        host_i, port_i = targets[i % len(targets)]
         single = [(f"GET {pref}/v1/census HTTP/1.1\r\nHost: l\r\n\r\n"
                    .encode()
                    if (i + j) % 2 else
@@ -196,7 +222,7 @@ def _service_client_main(port: int, n: int) -> int:
                    for k in range(32)]
 
         def connect():
-            s = socket.create_connection(("127.0.0.1", port),
+            s = socket.create_connection((host_i, port_i),
                                          timeout=30)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return s
@@ -244,19 +270,66 @@ def _service_client_main(port: int, n: int) -> int:
                     time.sleep(0.1)
                 buf = b""
             j += 1
-            t_next += interval * depth
-            lag = t_next - time.perf_counter()
-            if lag > 0:
-                time.sleep(lag)
-            else:
-                t_next = time.perf_counter()  # shed unpayable backlog
+            if throttled:
+                t_next += interval * depth
+                lag = t_next - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                else:
+                    t_next = time.perf_counter()  # shed backlog
         try:
             sock.close()
         except Exception:
             pass
 
+    lat_ms: list = []
+    stale: list = []
+
+    def sampler():
+        """Depth-1 request/response round trips on a connection of
+        their own: honest per-request latency, decoupled from the
+        pipelined throughput streams; plus /healthz staleness probes
+        (engine tick vs the tick of the snapshot answering reads)."""
+        import http.client as _hc
+        host_s, port_s = targets[0]
+        pref = prefixes[0]
+        conn = None
+        next_health = 0.0
+        k = 0
+        while not stop.is_set():
+            try:
+                if conn is None:
+                    conn = _hc.HTTPConnection(host_s, port_s,
+                                              timeout=10)
+                now = time.perf_counter()
+                if now >= next_health:
+                    next_health = now + 0.25
+                    conn.request("GET", f"{pref}/healthz")
+                    h = json.loads(conn.getresponse().read())
+                    st, tick = h.get("snapshot_tick"), h.get("tick")
+                    if st is not None and tick is not None:
+                        stale.append(max(int(tick) - int(st), 0))
+                    continue
+                path = (f"{pref}/v1/census" if k % 2 else
+                        f"{pref}/v1/member/{(k * 31) % n}")
+                k += 1
+                t0 = time.perf_counter()
+                conn.request("GET", path)
+                conn.getresponse().read()
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+                time.sleep(0.005)       # ~200 samples/s, off the path
+            except Exception:
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:
+                    pass
+                conn = None
+                time.sleep(0.1)
+
     workers = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(clients)]
+    workers.append(threading.Thread(target=sampler, daemon=True))
     t0 = time.perf_counter()
     for w in workers:
         w.start()
@@ -265,8 +338,16 @@ def _service_client_main(port: int, n: int) -> int:
     stop.set()
     for w in workers:
         w.join(timeout=30)
-    print(json.dumps({"queries": int(sum(counts)),
-                      "seconds": seconds}))
+    lat = sorted(lat_ms)
+    out = {"queries": int(sum(counts)), "seconds": seconds,
+           "p50_ms": (round(lat[len(lat) // 2], 4) if lat else None),
+           "p99_ms": (round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))], 4)
+                      if lat else None),
+           "staleness_mean_ticks": (round(sum(stale) / len(stale), 2)
+                                    if stale else None),
+           "staleness_max_ticks": (max(stale) if stale else None)}
+    print(json.dumps(out))
     return 0
 
 
@@ -304,12 +385,17 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
 
     clients = int(os.environ.get("BENCH_SERVICE_CLIENTS", "8"))
     reps = int(os.environ.get("BENCH_SERVICE_REPS", "2"))
+    # BENCH_SERVICE_WORKERS=W arms the read-replica pool on the served
+    # arm: the query load is then spread over the W replica processes
+    # (BENCH_SERVICE_PORTS) instead of the engine daemon's own API
+    # threads, which is the query-tier operating point PERF.md prices.
+    workers = int(os.environ.get("BENCH_SERVICE_WORKERS", "0"))
     # Segment length sets the snapshot cadence; ticks//8 keeps a single
     # compiled segment shape (no mid-run remainder compile inside the
     # measured query window) while exercising several boundaries.
     every = int(os.environ.get("BENCH_SERVICE_EVERY",
                                str(max(ticks // 8, 1))))
-    stats = []          # one {"queries", "seconds"} per served rep
+    stats = []          # one {"queries", "seconds", ...} per served rep
 
     tmp = tempfile.mkdtemp(prefix="bench_service_")
     base_out = os.path.join(tmp, "base")
@@ -320,7 +406,8 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
     p_serve = Params.from_text(
         base_text + f"CHECKPOINT_EVERY: {every}\n"
         f"CHECKPOINT_DIR: {os.path.join(serve_out, 'ck')}\n"
-        "SERVICE_PORT: 0\n")
+        "SERVICE_PORT: 0\n"
+        + (f"SERVICE_WORKERS: {workers}\n" if workers else ""))
 
     def _get(conn, path):
         conn.request("GET", path)
@@ -334,12 +421,15 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
         loop.  Queries are counted over the snapshot→complete window
         only — the sustained rate while the tick loop is live."""
         sj = os.path.join(out_dir, _daemon.SERVICE_JSON)
-        port = None
+        port, replicas = None, []
         deadline = time.time() + 600
         while time.time() < deadline:
             try:
                 with open(sj) as fh:
-                    port = json.load(fh)["port"]
+                    info = json.load(fh)
+                port = info["port"]
+                replicas = [r["port"] for r in
+                            info.get("replicas") or []]
                 break
             except (OSError, ValueError, KeyError):
                 time.sleep(0.02)
@@ -354,15 +444,22 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
                     or h["status"] in ("complete", "interrupted")):
                 break
             time.sleep(0.01)
+        env = dict(os.environ)
+        if replicas:
+            # The load lands on the replica pool; the engine port is
+            # only monitored.  Each client pins to one replica.
+            env["BENCH_SERVICE_PORTS"] = ",".join(map(str, replicas))
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__),
              "--service-client", str(port), "--n", str(n)],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=env)
         try:
             while True:
                 _, body = _get(mon, "/healthz")
-                if json.loads(body)["status"] in ("complete",
-                                                  "interrupted"):
+                h = json.loads(body)
+                if h["status"] in ("complete", "interrupted"):
+                    rec["derive"] = h.get("derive")
                     break
                 time.sleep(0.01)
         finally:
@@ -418,8 +515,10 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
                                   base_wall)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
-    qps = max((r["queries"] / r["seconds"] for r in stats), default=0.0)
-    return {
+    best = max(stats, key=lambda r: r["queries"] / r["seconds"],
+               default=None)
+    qps = (best["queries"] / best["seconds"]) if best else 0.0
+    out = {
         "service_every": every,
         "service_clients": clients,
         "service_base_wall_seconds": round(walls["base"], 3),
@@ -429,6 +528,77 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
             / max(walls["base"], 1e-9), 1),
         "service_queries_per_sec": round(qps, 1),
     }
+    if workers:
+        out["service_workers"] = workers
+    if best:
+        for src, dst in (("p50_ms", "service_p50_ms"),
+                         ("p99_ms", "service_p99_ms"),
+                         ("staleness_mean_ticks",
+                          "service_staleness_mean_ticks"),
+                         ("staleness_max_ticks",
+                          "service_staleness_max_ticks")):
+            if best.get(src) is not None:
+                out[dst] = best[src]
+        if best.get("derive"):
+            out["service_derive_mode"] = best["derive"].get("mode")
+            out["service_derive_ms"] = best["derive"].get("ms")
+    return out
+
+
+def _bench_service_connect(n: int) -> dict:
+    """BENCH_SERVICE_CONNECT=host:port[,host:port...]: the honest
+    OFF-BOX service bench.
+
+    No engine runs here — the targets are an already-serving daemon or
+    replica pool (possibly on another machine), so the measurement
+    carries real NIC/loopback cost and none of the load generator's
+    CPU is billed to the engine under test.  Spawns the same
+    ``--service-client`` subprocess arm against the targets for
+    BENCH_SERVICE_SECONDS (default 10), and reports sustained q/s,
+    sampled p50/p99 and answer staleness.  ``n`` bounds the member-id
+    space the clients probe (BENCH_SERVICE_N overrides)."""
+    connect = os.environ["BENCH_SERVICE_CONNECT"]
+    seconds = float(os.environ.get("BENCH_SERVICE_SECONDS", "10"))
+    n = int(os.environ.get("BENCH_SERVICE_N", str(n)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--service-client", "0", "--connect", connect,
+         "--n", str(n)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        time.sleep(seconds)
+    finally:
+        try:
+            out_text, _ = proc.communicate(input="stop\n", timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out_text = ""
+    rec = {}
+    for line in reversed((out_text or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    qps = rec.get("queries", 0) / max(rec.get("seconds", 1e-9), 1e-9)
+    out = {
+        "service_connect": connect,
+        "service_clients": int(
+            os.environ.get("BENCH_SERVICE_CLIENTS", "8")),
+        "service_queries_per_sec": round(qps, 1),
+    }
+    for src, dst in (("p50_ms", "service_p50_ms"),
+                     ("p99_ms", "service_p99_ms"),
+                     ("staleness_mean_ticks",
+                      "service_staleness_mean_ticks"),
+                     ("staleness_max_ticks",
+                      "service_staleness_max_ticks")):
+        if rec.get(src) is not None:
+            out[dst] = rec[src]
+    if os.environ.get("BENCH_SERVICE_WORKERS"):
+        out["service_workers"] = int(
+            os.environ["BENCH_SERVICE_WORKERS"])
+    return out
 
 
 def _bench_fleet() -> dict:
@@ -993,10 +1163,16 @@ def leg_hash(n: int, ticks: int, pin: str | None,
     # actually ships — pinning both arms to it isolates the serving
     # cost from kernel-eligibility differences.
     if os.environ.get("BENCH_SERVICE", "0") not in ("", "0"):
-        svc_text = (geom_text
-                    + "FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
-                    + tail_text)
-        ckpt_fields.update(_bench_service(svc_text, n, ticks))
+        if os.environ.get("BENCH_SERVICE_CONNECT"):
+            # Off-box mode: the service under test is already running
+            # (possibly on another host) — no local engine arms.
+            ckpt_fields.update(_bench_service_connect(n))
+        else:
+            svc_text = (geom_text
+                        + "FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\n"
+                          "FOLDED: 0\n"
+                        + tail_text)
+            ckpt_fields.update(_bench_service(svc_text, n, ticks))
     if os.environ.get("BENCH_RNG", "0") not in ("", "0"):
         ckpt_fields.update(_bench_rng_micro(
             make_config(params, collect_events=False)))
@@ -1193,21 +1369,40 @@ def _ledger_bank(leg: str, row: dict) -> None:
                    if k in row},
             source="bench.py")]
         if row.get("service_queries_per_sec"):
-            # The BENCH_SERVICE companion row: sustained client-side
+            # The BENCH_SERVICE companion rows: sustained client-side
             # query rate against the live daemon (the ISSUE's >= 500
             # q/s acceptance point), keyed apart from the tick-rate
             # rung so perfdb's regression check tracks each trend.
+            # knobs["service_workers"] keys the rung per pool width
+            # (rung:w{W}); p50/p99 and answer staleness ride as
+            # separate lower-is-better metrics on the same rung.
+            svc_knobs = {"clients": row.get("service_clients"),
+                         "ticks": row.get("ticks")}
+            if row.get("service_overhead_pct") is not None:
+                svc_knobs["overhead_pct"] = row["service_overhead_pct"]
+            if row.get("service_workers"):
+                svc_knobs["service_workers"] = row["service_workers"]
+            if row.get("service_connect"):
+                svc_knobs["connect"] = row["service_connect"]
+            svc_common = dict(
+                n=row.get("n"), s=row.get("view_size"),
+                backend="tpu_hash" if leg == "hash" else "dense",
+                platform=row.get("platform"), knobs=svc_knobs,
+                source="bench.py")
             rows.append(perfdb.make_row(
                 f"bench:live:{leg}:service",
                 metric="service_queries_per_sec",
-                value=row["service_queries_per_sec"], n=row.get("n"),
-                s=row.get("view_size"),
-                backend="tpu_hash" if leg == "hash" else "dense",
-                platform=row.get("platform"),
-                knobs={"clients": row.get("service_clients"),
-                       "overhead_pct": row.get("service_overhead_pct"),
-                       "ticks": row.get("ticks")},
-                source="bench.py"))
+                value=row["service_queries_per_sec"], **svc_common))
+            for metric, field in (
+                    ("service_p50_ms", "service_p50_ms"),
+                    ("service_p99_ms", "service_p99_ms"),
+                    ("service_staleness_ticks",
+                     "service_staleness_mean_ticks")):
+                if row.get(field) is not None:
+                    rows.append(perfdb.make_row(
+                        f"bench:live:{leg}:service", metric=metric,
+                        value=row[field], higher_is_better=False,
+                        **svc_common))
         if row.get("fprobe_wall_seconds"):
             # The BENCH_FPROBE companion row: fused-vs-unfused probe
             # traversal delta (positive = the Pallas kernel wins), keyed
@@ -1331,10 +1526,13 @@ def main() -> int:
     ap.add_argument("--pin-cpu", action="store_true")
     ap.add_argument("--service-client", type=int, default=None,
                     metavar="PORT", help=argparse.SUPPRESS)
+    ap.add_argument("--connect", default="",
+                    metavar="HOST:PORT", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.service_client is not None:   # _bench_service's query load
-        return _service_client_main(args.service_client, args.n)
+        return _service_client_main(args.service_client, args.n,
+                                    connect=args.connect)
 
     if args.leg:   # child mode
         pin = "cpu" if args.pin_cpu else None

@@ -347,6 +347,20 @@ class Params:
     # already-pulled carry; raise this on very large runs if the
     # boundary-time decode shows up in runlog.jsonl flush_s.
     SERVICE_SNAPSHOT_EVERY: int = 1
+    # Read-replica pool (service/replica.py): 0 = queries answered by
+    # the engine daemon's own API thread (the classic posture); W >= 1
+    # spawns W read-only worker PROCESSES that map the snapshot shm
+    # ring (service/shm_ring.py) and serve the whole GET surface on
+    # their own ports (service.json lists them) — reads scale across
+    # cores while writes (/v1/events, admin) stay on the engine
+    # daemon.  Trajectory-inert, identity-excluded like SERVICE_PORT.
+    SERVICE_WORKERS: int = 0
+    # Slots in the shared-memory snapshot ring (>= 2).  A reader holds
+    # a slot for at most one request while the writer cycles the ring,
+    # so B slots give a reader B-1 publication intervals of slack
+    # before a seqlock retry; raise it if replicas report torn reads
+    # under very fast boundaries.
+    SERVICE_SHM_BUFFERS: int = 4
     # Fleet controller (fleet/ package, ``--fleet``): one control-plane
     # process owning a journaled run registry and a bounded-worker
     # scheduler, multiplexing many runs (each a subprocess driving the
@@ -567,6 +581,20 @@ class Params:
             raise ValueError(
                 f"SERVICE_SNAPSHOT_EVERY must be >= 1 segment "
                 f"boundaries, got {self.SERVICE_SNAPSHOT_EVERY}")
+        if self.SERVICE_WORKERS < 0:
+            raise ValueError(
+                f"SERVICE_WORKERS must be >= 0 replica processes, got "
+                f"{self.SERVICE_WORKERS}")
+        if self.SERVICE_WORKERS > 0 and self.SERVICE_PORT < 0:
+            raise ValueError(
+                "SERVICE_WORKERS requires the control plane "
+                "(SERVICE_PORT >= 0): the serve daemon publishes the "
+                "shm ring the replicas read")
+        if self.SERVICE_SHM_BUFFERS < 2:
+            raise ValueError(
+                f"SERVICE_SHM_BUFFERS must be >= 2 ring slots (the "
+                f"seqlock needs a stable slot while the writer fills "
+                f"another), got {self.SERVICE_SHM_BUFFERS}")
         if not -1 <= self.FLEET_PORT <= 65535:
             raise ValueError(
                 f"FLEET_PORT must be -1 (off), 0 (ephemeral) or a "

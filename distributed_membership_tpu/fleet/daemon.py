@@ -33,7 +33,7 @@ from typing import Optional, Tuple
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.fleet.registry import Registry
 from distributed_membership_tpu.fleet.scheduler import (
-    Scheduler, reap_orphans)
+    Scheduler, reap_orphans, sweep_stale_rings)
 from distributed_membership_tpu.service import api
 
 FLEET_JSON = "fleet.json"
@@ -55,6 +55,7 @@ class FleetState:
         self.started_at = time.time()
         self.port: Optional[int] = None
         self.queries = 0
+        self.rr = 0             # replica round-robin cursor (proxy)
 
     # -- fleet routes (each returns (code, json-able)) -----------------
     def health(self) -> dict:
@@ -202,6 +203,16 @@ class FleetState:
 
 
 # -- the proxy ---------------------------------------------------------
+# GETs a read replica answers byte-identically to the engine daemon —
+# everything that reads the published snapshot/timeline.  /healthz is
+# deliberately absent: proxied health means the RUN's health.
+_REPLICA_ROUTES = ("/v1/census", "/v1/timeline", "/v1/stream")
+
+
+def _replica_route(rest: str) -> bool:
+    return rest in _REPLICA_ROUTES or rest.startswith("/v1/member/")
+
+
 def proxy(h: api.ApiHandler, state: FleetState, run_id: str,
           rest: str, query: str, body: Optional[bytes]) -> None:
     """Forward one request to the run's worker daemon, verbatim.
@@ -211,12 +222,25 @@ def proxy(h: api.ApiHandler, state: FleetState, run_id: str,
     makes ``/v1/runs/<id>/X`` answer byte-identically to the worker's
     own ``X`` — no route is ever re-implemented here.  SSE responses
     are streamed chunk-by-chunk; everything else is relayed whole.
+
+    Query routing: when the run's worker publishes a replica pool
+    (SERVICE_WORKERS), snapshot GETs are spread round-robin over the
+    replicas — the engine daemon answers the same bytes, so this is
+    pure load distribution.  A dead replica fails over to the next
+    candidate (survivors first, engine last); writes, admin verbs and
+    ``/healthz`` (the RUN's health, not a replica's) always go to the
+    engine.  502 only when every candidate refuses.
     """
     import http.client
     with state.lock:
         rec = state.registry.runs.get(run_id)
         port = (None if rec is None
                 else state.scheduler.worker_port(run_id))
+        replicas = ([] if rec is None or body is not None
+                    or not _replica_route(rest)
+                    else state.scheduler.replica_ports(run_id))
+        state.rr += 1
+        rr = state.rr
     if rec is None:
         h._json(404, {"error": f"unknown run {run_id!r}"})
         return
@@ -240,52 +264,64 @@ def proxy(h: api.ApiHandler, state: FleetState, run_id: str,
         return
     target = rest + (f"?{query}" if query else "")
     method = "GET" if body is None else "POST"
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=None)
-    try:
-        # Upstream and downstream failures must not be conflated: a
-        # worker dying mid-request raises RemoteDisconnected — a
-        # ConnectionResetError subclass, i.e. the SAME type our own
-        # client raises by hanging up — and treating it as "our client
-        # left" would swallow the request and leave the real client
-        # blocked with no reply.  So the worker conversation runs in
-        # its own try (any OSError -> 502), and only writes to
-        # ``h.wfile`` may re-raise out to do_* (which handles a gone
-        # client).
+    # Candidate order: the replica pool rotated by the shared cursor
+    # (so consecutive requests land on different replicas), engine
+    # last as the always-correct fallback.  Failover advances on
+    # connection-level failure, BEFORE any bytes went downstream.
+    k = rr % len(replicas) if replicas else 0
+    candidates = replicas[k:] + replicas[:k] + [port]
+    last_err: Optional[OSError] = None
+    for upstream in candidates:
+        conn = http.client.HTTPConnection("127.0.0.1", upstream,
+                                          timeout=None)
         try:
-            headers = {}
-            if body is not None:
-                headers = {"Content-Type": "application/json",
-                           "Content-Length": str(len(body))}
-            conn.request(method, target, body=body, headers=headers)
-            resp = conn.getresponse()
-            ctype = resp.getheader("Content-Type",
-                                   "application/json")
-            data = (None if ctype.startswith("text/event-stream")
-                    else resp.read())
-        except OSError as e:
-            h._json(502, {"error": f"worker for run {run_id!r} did "
-                                   f"not answer ({e})"})
-            return
-        if data is not None:
-            h._body(resp.status, data)
-            return
-        h.send_response(resp.status)
-        h.send_header("Content-Type", ctype)
-        h.send_header("Cache-Control", "no-cache")
-        h.send_header("Connection", "close")
-        h.end_headers()
-        while True:
+            # Upstream and downstream failures must not be conflated:
+            # a worker dying mid-request raises RemoteDisconnected — a
+            # ConnectionResetError subclass, i.e. the SAME type our
+            # own client raises by hanging up — and treating it as
+            # "our client left" would swallow the request and leave
+            # the real client blocked with no reply.  So the worker
+            # conversation runs in its own try (any OSError -> next
+            # candidate, then 502), and only writes to ``h.wfile`` may
+            # re-raise out to do_* (which handles a gone client).
             try:
-                chunk = resp.read1(65536)
-            except OSError:
-                break              # upstream died mid-stream
-            if not chunk:
-                break
-            h.wfile.write(chunk)
-            h.wfile.flush()
-        h.close_connection = True
-    finally:
-        conn.close()
+                headers = {}
+                if body is not None:
+                    headers = {"Content-Type": "application/json",
+                               "Content-Length": str(len(body))}
+                conn.request(method, target, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                ctype = resp.getheader("Content-Type",
+                                       "application/json")
+                data = (None if ctype.startswith("text/event-stream")
+                        else resp.read())
+            except OSError as e:
+                last_err = e
+                continue           # dead candidate: try the next one
+            if data is not None:
+                h._body(resp.status, data)
+                return
+            h.send_response(resp.status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Cache-Control", "no-cache")
+            h.send_header("Connection", "close")
+            h.end_headers()
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except OSError:
+                    break          # upstream died mid-stream
+                if not chunk:
+                    break
+                h.wfile.write(chunk)
+                h.wfile.flush()
+            h.close_connection = True
+            return
+        finally:
+            conn.close()
+    h._json(502, {"error": f"worker for run {run_id!r} did not "
+                           f"answer ({last_err})"})
 
 
 # -- routing -----------------------------------------------------------
@@ -397,6 +433,10 @@ def fleet_main(root: str, port: int = 0, max_concurrency: int = 2,
     if orphans:
         print(f"fleet: reaped {orphans} orphaned worker(s) from a "
               "previous controller", flush=True)
+    rings = sweep_stale_rings()
+    if rings:
+        print(f"fleet: unlinked {rings} stale snapshot ring(s) from "
+              "dead daemons", flush=True)
     recovered = registry.recover()
     lock = threading.Lock()
     scheduler = Scheduler(registry, max_concurrency, lock,

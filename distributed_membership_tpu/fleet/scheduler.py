@@ -100,6 +100,37 @@ def reap_orphans(journal_rows: list, root: str) -> int:
     return killed
 
 
+def sweep_stale_rings() -> int:
+    """Unlink snapshot-ring shm segments whose creator daemon is gone;
+    -> count unlinked.
+
+    The ring name encodes the creating daemon's pid
+    (``dmring_<pid:x>_<nonce>``), and every live consumer holds a
+    mapping that survives the unlink — so removing a segment whose
+    creator pid no longer exists (or belongs to another user's
+    process, which a worker of ours can never be) is always safe.
+    Covers the one leak path the in-band teardown can't: worker AND
+    all its replicas SIGKILLed before any of them unlinked.
+    """
+    from distributed_membership_tpu.service import shm_ring
+    swept = 0
+    for name in shm_ring.stale_segments():
+        try:
+            pid = int(name.split("_")[1], 16)
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)
+            continue                       # creator alive: ring in use
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue                       # EPERM: not our process
+        if shm_ring.unlink(name):
+            swept += 1
+    return swept
+
+
 def _http(port: int, method: str, path: str,
           timeout: float = 2.0) -> Optional[dict]:
     """One JSON round-trip to a worker daemon; None on any failure."""
@@ -148,6 +179,20 @@ class _Worker:
         if info.get("pid") == self.proc.pid:
             self.port = int(info["port"])
         return self.port
+
+    def discover_replicas(self) -> list:
+        """Ports of the worker's read-replica pool (service.json
+        ``replicas``, pid-checked like :meth:`discover_port`); [] when
+        the worker runs without a query tier."""
+        try:
+            with open(os.path.join(self.run_dir, SERVICE_JSON)) as fh:
+                info = json.load(fh)
+        except (OSError, ValueError):
+            return []
+        if info.get("pid") != self.proc.pid:
+            return []
+        return [int(r["port"]) for r in info.get("replicas") or []
+                if isinstance(r, dict) and r.get("port")]
 
     def log_tail(self, limit: int = 400) -> str:
         try:
@@ -293,6 +338,12 @@ class Scheduler:
         if w is None or w.proc.poll() is not None:
             return None
         return w.discover_port()
+
+    def replica_ports(self, run_id: str) -> list:
+        w = self.workers.get(run_id)
+        if w is None or w.proc.poll() is not None:
+            return []
+        return w.discover_replicas()
 
     # -- internals (under the fleet lock) ------------------------------
     def _spawn(self, rec: RunRecord) -> None:

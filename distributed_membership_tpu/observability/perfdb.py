@@ -74,6 +74,13 @@ def make_row(rung: str, *, metric: str, value: float,
     # operating points move independently).
     if knobs.get("procs"):
         rung = f"{rung}:p{int(knobs['procs'])}"
+    # Query-tier rows key per POOL WIDTH too: a truthy
+    # knobs["service_workers"] lifts W into the rung (rung:w{W}) — the
+    # engine-serves-queries point (W=0) and the replica-pool points
+    # scale differently (one GIL vs W processes) and must trend
+    # separately in the regression report.
+    if knobs.get("service_workers"):
+        rung = f"{rung}:w{int(knobs['service_workers'])}"
     digest = knobs_digest(knobs)
     key = "|".join([rung, str(n), str(s), str(backend), str(platform),
                     metric, digest])

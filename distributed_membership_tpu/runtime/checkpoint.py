@@ -84,6 +84,12 @@ _IDENTITY_EXCLUDE = frozenset(
      # different port (or not serve at all) without invalidating the
      # run (tests/test_service.py pins serve-on/off bit-exactness).
      "SERVICE_PORT", "SERVICE_SNAPSHOT_EVERY",
+     # The query tier rides the same contract: replicas read snapshots
+     # out of shared memory the publisher thread wrote off the engine
+     # thread; neither the pool size nor the ring depth can reach the
+     # per-tick math (tests/test_query_tier.py pins replica replies
+     # byte-identical to the engine's own).
+     "SERVICE_WORKERS", "SERVICE_SHM_BUFFERS",
      # The fleet keys configure the CONTROLLER process, never the run's
      # per-tick math — a conf submitted to a fleet resumes bit-exactly
      # under a controller with different scheduling knobs (or none).

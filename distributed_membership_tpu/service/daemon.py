@@ -28,6 +28,9 @@ from __future__ import annotations
 import json
 import os
 import random
+import signal
+import subprocess
+import sys
 import threading
 import time
 from typing import List, Optional
@@ -42,6 +45,109 @@ from distributed_membership_tpu.service.snapshot import (
     SnapshotStore, decode_state)
 
 SERVICE_JSON = "service.json"
+
+
+class SnapshotPublisher(threading.Thread):
+    """The off-engine-thread snapshot pipeline.
+
+    The boundary hook's only snapshot work is :meth:`submit` — stash
+    the host carry reference (the chunked driver rebinds its own
+    carry to fresh arrays every segment, so the submitted arrays are
+    never mutated) and notify.  This thread does everything O(N*S):
+    decode, the incremental (or fallback full) derive, the census
+    pre-encode, the store swap, and the shm-ring write for the
+    replica pool.  The mailbox is latest-wins: if the engine laps the
+    publisher, intermediate boundaries are skipped, never queued —
+    boundary work on the engine thread stays O(N) regardless of
+    publisher backlog (the acceptance criterion
+    tests/test_query_tier.py asserts by thread identity).
+
+    :meth:`drain` blocks until the newest submitted boundary is
+    published — serve_run calls it before flipping the run status to
+    complete, so the final snapshot is always visible to pollers that
+    key on ``status``.
+    """
+
+    def __init__(self, state: "ControlState", ring=None):
+        super().__init__(daemon=True, name="snapshot-publisher")
+        self.state = state
+        self.ring = ring
+        self._cv = threading.Condition()
+        self._item = None
+        self._closing = False
+        self._submitted: Optional[int] = None
+        self._published: Optional[int] = None
+        self.publishes = 0
+        self.last_derive: Optional[dict] = None
+
+    def submit(self, carry, tick: int) -> None:
+        with self._cv:
+            self._item = (carry, int(tick))
+            self._submitted = int(tick)
+            self._cv.notify_all()
+
+    def run(self) -> None:
+        params = self.state.params
+        n, tfail = params.EN_GPSZ, params.TFAIL
+        prev = None
+        while True:
+            with self._cv:
+                while self._item is None and not self._closing:
+                    self._cv.wait()
+                if self._item is None:
+                    return
+                carry, tick = self._item
+                self._item = None
+            try:
+                snap = decode_state(carry, tick, n, tfail)
+                snap.precompute(prev)
+            except AttributeError as e:   # undecodable carry layout
+                self.state.snapshot_error = str(e)
+                with self._cv:
+                    self._published = tick
+                    self._cv.notify_all()
+                continue
+            self.state.store.publish(snap)
+            if self.ring is not None:
+                try:
+                    self.ring.publish(snap, prev)
+                except Exception as e:
+                    self.state.snapshot_error = f"shm publish: {e}"
+            self.push_engine_meta()
+            self.publishes += 1
+            self.last_derive = snap.derive_info
+            prev = snap
+            with self._cv:
+                self._published = tick
+                self._cv.notify_all()
+
+    def push_engine_meta(self) -> None:
+        """Refresh the ring's lock-free engine-liveness fields (also
+        called by serve_run on status transitions, so replicas see
+        ``complete`` without waiting for another boundary)."""
+        if self.ring is not None:
+            try:
+                self.ring.set_engine(self.state.status,
+                                     self.state.tick,
+                                     len(self.state.applied))
+            except Exception:
+                pass
+
+    def drain(self, timeout_s: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while (self._item is not None
+                   or self._published != self._submitted):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+    def close(self) -> None:
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
 
 
 class ControlState:
@@ -68,6 +174,11 @@ class ControlState:
         self.applied_at: List[dict] = []  # [{tick, events}] audit trail
         self.snapshot_error = ""
         self.stop_event = threading.Event()
+        # serve_run arms these; unit-level ControlState uses stay None
+        # (the boundary hook then publishes synchronously, underived).
+        self.publisher: Optional[SnapshotPublisher] = None
+        self.replicas: List[dict] = []      # [{index, port, pid}]
+        self.shm_name: Optional[str] = None
         self._lock = threading.Lock()
         self._inject_unsupported = injection_unsupported(params)
         # The run mesh (tpu_hash_sharded only), resolved ONCE by
@@ -99,6 +210,12 @@ class ControlState:
         }
         if self.snapshot_error:
             h["snapshot_error"] = self.snapshot_error
+        if self.publisher is not None:
+            h["publishes"] = self.publisher.publishes
+            h["derive"] = self.publisher.last_derive
+        if self.replicas:
+            h["replicas"] = [{k: r[k] for k in ("index", "port", "pid")}
+                             for r in self.replicas]
         return h
 
     def timeline_path(self) -> Optional[str]:
@@ -178,10 +295,16 @@ def _make_hook(state: ControlState):
     def hook(carry, tick: int):
         i, boundary_no[0] = boundary_no[0], boundary_no[0] + 1
         if i % decode_every == 0 or tick >= state.total:
-            try:
-                state.store.publish(decode_state(carry, tick, n, tfail))
-            except AttributeError as e:   # undecodable carry layout
-                state.snapshot_error = str(e)
+            if state.publisher is not None:
+                # O(1) on the engine thread: the decode/derive/census/
+                # shm pipeline runs on the publisher thread.
+                state.publisher.submit(carry, tick)
+            else:
+                try:
+                    state.store.publish(
+                        decode_state(carry, tick, n, tfail))
+                except AttributeError as e:   # undecodable carry
+                    state.snapshot_error = str(e)
         upd = {}
         with state._lock:
             state.tick = tick
@@ -266,11 +389,101 @@ def port_in_use_hint(err, out_dir: str) -> str:
 
 def _write_service_json(out_dir: str, state: ControlState) -> None:
     os.makedirs(out_dir, exist_ok=True)
+    doc = {"port": state.port, "pid": os.getpid(),
+           "backend": state.params.BACKEND,
+           "n": state.params.EN_GPSZ, "total": state.total}
+    if state.replicas:
+        doc["replicas"] = [{k: r[k] for k in ("index", "port", "pid")}
+                           for r in state.replicas]
+    if state.shm_name:
+        doc["shm"] = state.shm_name
     with open(os.path.join(out_dir, SERVICE_JSON), "w") as fh:
-        json.dump({"port": state.port, "pid": os.getpid(),
-                   "backend": state.params.BACKEND,
-                   "n": state.params.EN_GPSZ, "total": state.total},
-                  fh, indent=1)
+        json.dump(doc, fh, indent=1)
+
+
+def _leash_sigterm():
+    """preexec_fn for replicas: SIGTERM when the daemon dies (Linux
+    PR_SET_PDEATHSIG) — the replica's handler distinguishes parent
+    death (unlink the ring) from an individual kill (leave it)."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGTERM)      # PR_SET_PDEATHSIG = 1
+    except Exception:
+        pass
+
+
+def spawn_replicas(state: ControlState, out_dir: str,
+                   ring_name: str, workers: int) -> List[dict]:
+    """Start ``workers`` read-replica processes against ``ring_name``
+    and wait for each one's hello line (its bound port).  Replicas
+    hold a stdin pipe (EOF = daemon gone, even on SIGKILL) and a
+    PDEATHSIG leash; stdout carries exactly the one hello line, then
+    beacons go to ``replica_<i>.json`` files."""
+    import selectors
+    timeline = state.timeline_path() or ""
+    procs = []
+    for i in range(workers):
+        argv = [sys.executable, "-m",
+                "distributed_membership_tpu.service.replica",
+                "--ring", ring_name, "--port", "0", "--dir", out_dir,
+                "--index", str(i)]
+        if timeline:
+            argv += ["--timeline", timeline]
+        kwargs = {}
+        if os.name == "posix":
+            kwargs["preexec_fn"] = _leash_sigterm
+        procs.append(subprocess.Popen(
+            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, **kwargs))
+    out = []
+    try:
+        for i, p in enumerate(procs):
+            sel = selectors.DefaultSelector()
+            sel.register(p.stdout, selectors.EVENT_READ)
+            line = ""
+            if sel.select(timeout=30):
+                line = p.stdout.readline()
+            sel.close()
+            try:
+                hello = json.loads(line)
+                out.append({"index": i, "port": int(hello["port"]),
+                            "pid": p.pid, "proc": p})
+            except (ValueError, KeyError, TypeError):
+                raise RuntimeError(
+                    f"replica {i} failed to start (rc={p.poll()})")
+    except BaseException:
+        stop_replicas([{"proc": p} for p in procs])
+        raise
+    return out
+
+
+def stop_replicas(replicas: List[dict]) -> None:
+    """Tear the pool down: close stdin (the replicas' parent-death
+    signal — they best-effort unlink the ring and exit), then
+    escalate to kill for stragglers."""
+    for r in replicas:
+        p = r.get("proc")
+        if p is None:
+            continue
+        for f in (p.stdin, p.stdout):
+            try:
+                if f:
+                    f.close()
+            except OSError:
+                pass
+    for r in replicas:
+        p = r.get("proc")
+        if p is None:
+            continue
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def resume_journal_run(params: Params, log: EventLog,
@@ -351,6 +564,37 @@ def serve_run(params: Params, seed: Optional[int] = None,
     state.port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="service-api").start()
+
+    # Query tier: every served run derives/encodes snapshots off the
+    # engine thread; with SERVICE_WORKERS > 0 the publisher also lands
+    # them in a shm ring feeding a pool of read-replica processes.
+    ring = None
+    workers = getattr(params, "SERVICE_WORKERS", 0)
+    if workers > 0:
+        import numpy as np
+
+        from distributed_membership_tpu.service.shm_ring import (
+            ShmRingWriter)
+        n = params.EN_GPSZ
+        s = params.VIEW_SIZE if params.VIEW_SIZE > 0 else n
+        ring = ShmRingWriter(
+            n, s, np.uint32, np.int32, params.TFAIL, state.total,
+            getattr(params, "SERVICE_SHM_BUFFERS", 4))
+        state.shm_name = ring.name
+    state.publisher = SnapshotPublisher(state, ring)
+    state.publisher.start()
+    replicas = []
+    if workers > 0:
+        try:
+            replicas = spawn_replicas(state, out_dir, ring.name,
+                                      workers)
+        except BaseException:
+            ring.close()
+            raise
+        state.replicas = replicas
+        print(f"service: {len(replicas)} read replica(s) on ports "
+              f"{[r['port'] for r in replicas]}", flush=True)
+
     _write_service_json(out_dir, state)
     print(f"service: listening on 127.0.0.1:{state.port} "
           f"(pid {os.getpid()})", flush=True)
@@ -363,9 +607,15 @@ def serve_run(params: Params, seed: Optional[int] = None,
                                       mesh=state.mesh)
         except RunInterrupted as e:
             state.status = "interrupted"
+            state.publisher.drain()
+            state.publisher.push_engine_meta()
             print(f"service: {e} — resume with --resume", flush=True)
             return 0
+        # Final boundary visible BEFORE the status flips: pollers that
+        # key on status == complete must see the final snapshot.
+        state.publisher.drain()
         state.status = "complete"
+        state.publisher.push_engine_meta()
         # The batch driver's artifact tail (runtime/application.py).
         result.log.flush(out_dir)
         if not result.extra.get("aggregate"):
@@ -380,6 +630,11 @@ def serve_run(params: Params, seed: Optional[int] = None,
     finally:
         server.shutdown()
         server.server_close()
+        state.publisher.close()
+        if replicas:
+            stop_replicas(replicas)
+        if ring is not None:
+            ring.close()
 
 
 def serve_conf(conf_path: str, port: Optional[int] = None,

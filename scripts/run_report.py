@@ -133,6 +133,29 @@ def _ladder_stats(events: list) -> dict:
     return out
 
 
+def _replica_beacons(directory: str) -> list:
+    """The query tier's ``replica_<i>.json`` beacons (one per read
+    replica, rewritten every second — service/replica.py), sorted by
+    replica index.  Beacons whose ``time`` stamp is older than 10s are
+    marked stale (a dead replica's last beacon stays on disk)."""
+    import glob
+    rows = []
+    now = time.time()
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "replica_*.json"))):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or doc.get("role") != "replica":
+            continue
+        doc["stale"] = bool(now - doc.get("time", 0) > 10)
+        rows.append(doc)
+    rows.sort(key=lambda d: d.get("index", 0))
+    return rows
+
+
 def build_report(directory: str | None,
                  ladder_path: str | None = None,
                  slo: bool = False) -> dict:
@@ -162,6 +185,19 @@ def build_report(directory: str | None,
         if os.path.exists(sc_path):
             with open(sc_path) as fh:
                 report["scenario"] = json.load(fh)
+        replicas = _replica_beacons(directory)
+        if replicas:
+            report["query_tier"] = {
+                "replicas": replicas,
+                "qps_total": round(sum(r.get("qps") or 0
+                                       for r in replicas
+                                       if not r["stale"]), 1),
+                "tick_lag_max": max(
+                    (r["tick_lag"] for r in replicas
+                     if not r["stale"]
+                     and r.get("tick_lag") is not None),
+                    default=None),
+            }
     if ladder_path and os.path.exists(ladder_path):
         report["ladder"] = _ladder_stats(read_events(ladder_path))
     # Reconciliation: the per-tick series must sum to the run verdicts
@@ -309,6 +345,24 @@ def render_markdown(report: dict) -> str:
                   "| check | ok |", "|---|---|"]
         lines += _md_kv(rc)
         lines.append("")
+    qt = report.get("query_tier")
+    if qt:
+        lines += ["## Query tier (read replicas)", "",
+                  f"aggregate **{qt['qps_total']} q/s**, snapshot "
+                  f"lag max **{qt['tick_lag_max']}** tick(s)", "",
+                  "| replica | port | q/s | p50 ms | p99 ms | "
+                  "snapshot tick | gen | lag | status |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+        for r in qt["replicas"]:
+            lines.append(
+                f"| {r.get('index')} | {r.get('port')} | "
+                f"{r.get('qps', '-')} | {r.get('p50_ms', '-')} | "
+                f"{r.get('p99_ms', '-')} | "
+                f"{r.get('snapshot_tick', '-')} | "
+                f"{r.get('snapshot_gen', '-')} | "
+                f"{r.get('tick_lag', '-')} | "
+                f"{'stale' if r['stale'] else r.get('engine_status')} |")
+        lines.append("")
     seg = report.get("segments")
     if seg:
         lines += ["## Segment timings (chunked driver)", "",
@@ -432,6 +486,15 @@ def fleet_report(root: str) -> dict:
                 row["slo"] = bool(json.load(fh).get("passed"))
         except (OSError, ValueError):
             pass
+        replicas = [r for r in _replica_beacons(run_dir)
+                    if not r["stale"]]
+        if replicas:
+            row["query_qps"] = round(sum(r.get("qps") or 0
+                                         for r in replicas), 1)
+            row["query_lag"] = max(
+                (r["tick_lag"] for r in replicas
+                 if r.get("tick_lag") is not None), default=None)
+            row["query_replicas"] = len(replicas)
         rows.append(row)
     return {"root": root, "runs": rows}
 
@@ -443,9 +506,15 @@ def render_fleet(report: dict) -> str:
         live = "-" if r["live"] is None else str(r["live"])
         slo = ("-" if r["slo"] is None
                else "pass" if r["slo"] else "FAIL")
-        lines.append(f"{r['run_id']:<12} {r['state']:<13} "
-                     f"tick {r['tick']:>6}/{r['total']:<6} "
-                     f"live {live:<6} slo {slo}")
+        line = (f"{r['run_id']:<12} {r['state']:<13} "
+                f"tick {r['tick']:>6}/{r['total']:<6} "
+                f"live {live:<6} slo {slo}")
+        if r.get("query_replicas"):
+            lag = ("-" if r.get("query_lag") is None
+                   else r["query_lag"])
+            line += (f"  query {r['query_qps']} q/s "
+                     f"x{r['query_replicas']} lag {lag}")
+        lines.append(line)
     return "\n".join(lines)
 
 

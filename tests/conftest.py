@@ -65,6 +65,12 @@ _QUICK = {
                              "test_folded_fused_config_gates"},
     "test_shell_oracle.py": {"test_magic_first_line"},
     "test_package_results.py": {"test_package_results_archive"},
+    "test_query_tier.py": {
+        "test_incremental_derive_matches_full_oracle[64]",
+        "test_shm_ring_roundtrip_delta_and_seqlock",
+        "test_grading_identity[singlefailure]",
+        "test_fleet_proxy_replica_failover",
+        "test_run_report_query_tier_rows"},
 }
 
 

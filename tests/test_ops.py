@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_membership_tpu.ops.merge import fanout_deliver, _chunk_size
 from distributed_membership_tpu.ops.sampling import sample_k_distinct
@@ -43,7 +44,11 @@ def test_fanout_deliver_max_and_counts():
     np.testing.assert_array_equal(np.asarray(recv), [0, 3, 6])
 
 
+@pytest.mark.slow
 def test_fanout_deliver_drops():
+    """300 sequential dispatches take ~34 s — over the tier-1 wall
+    budget.  Drop-path correctness stays tier-1 via the window-closed
+    test below and the quick-tier fanout_deliver_max_and_counts."""
     target = jnp.ones((1, 1), bool)
     hb = jnp.zeros((1, 1), jnp.int32)
     n_kept = 0

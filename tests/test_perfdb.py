@@ -94,6 +94,37 @@ def test_make_row_keys_multiprocess_rows_per_topology():
     assert both["rung"] == "r:t8:p2"
 
 
+def test_make_row_keys_query_tier_rows_per_pool_width():
+    """Query-tier rows key by (rung, W): a truthy
+    knobs["service_workers"] lifts the pool width into the rung
+    (rung:w{W}) so the engine-serves-queries point (W=0, one GIL) and
+    the replica-pool points (W processes) trend as separate --check
+    histories — a healthy W=0 history must never absorb a pool
+    collapse."""
+    def row(workers, value):
+        knobs = {"clients": 8}
+        if workers:
+            knobs["service_workers"] = workers
+        return perfdb.make_row(
+            "bench:live:hash:service", metric="query_qps",
+            value=value, n=4096, s=16, backend="tpu_hash",
+            platform="cpu", knobs=knobs)
+
+    r0, r4 = row(0, 600.0), row(4, 5000.0)
+    assert r0["rung"] == "bench:live:hash:service"
+    assert r4["rung"] == "bench:live:hash:service:w4"
+    assert r0["key"] != r4["key"]
+    hist = [row(0, 600.0), row(4, 5000.0), row(0, 580.0), row(4, 900.0)]
+    bad = perfdb.check(hist)
+    assert (len(bad) == 1
+            and bad[0]["rung"] == "bench:live:hash:service:w4")
+    # Composition: all three lifts stack t first, then p, then w.
+    allthree = perfdb.make_row(
+        "r", metric="m", value=1.0,
+        knobs={"mega_ticks": 8, "procs": 2, "service_workers": 4})
+    assert allthree["rung"] == "r:t8:p2:w4"
+
+
 @pytest.mark.quick
 def test_append_is_idempotent_and_torn_tolerant(tmp_path):
     path = str(tmp_path / "ledger.jsonl")

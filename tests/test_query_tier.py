@@ -1,0 +1,860 @@
+"""Query tier: incremental snapshot deltas + shm read replicas.
+
+The acceptance pins for the high-QPS serving path, in four layers:
+
+  * the incremental derive (service/snapshot.py) is BYTE-IDENTICAL to
+    the full double-sort oracle — checked on an adversarial synthetic
+    chain (liveness flips, dead-row churn, pow2 and non-pow2 N) and at
+    every published boundary of the grading scenarios, including the
+    SIGTERM/--resume chain (the delta state survives nothing across a
+    restart; the first post-resume publish falls back to full);
+  * boundary work on the engine thread is O(N): ZERO O(N*S) derives
+    ever run on the engine thread (asserted by thread identity — the
+    engine runs in pytest's main thread, derivation must happen on the
+    daemon's "snapshot-publisher" thread);
+  * the shm ring (service/shm_ring.py): roundtrip fidelity, delta row
+    accounting (a quiet republish rewrites only the changed rows),
+    seqlock torn-read detection, idempotent unlink;
+  * the replica pool: byte-equal replies vs the engine daemon, SSE
+    across delta publications, replica SIGKILL mid-stream (clean
+    disconnect, siblings and publisher unaffected), no /dev/shm leak
+    after the daemon is SIGKILLed, and the fleet proxy's failover
+    (dead replica -> survivor -> engine; 502 only when all refuse).
+"""
+
+import http.client
+import http.server
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.service import shm_ring
+from distributed_membership_tpu.service import snapshot as snapshot_mod
+from distributed_membership_tpu.service.daemon import (
+    SERVICE_JSON, serve_conf, serve_run)
+from distributed_membership_tpu.service.snapshot import Snapshot
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TESTDIR = REPO / "testcases"
+SEED = 3
+EVERY = 50
+
+
+# ---------------------------------------------------------------------------
+# Client helpers (same idioms as tests/test_service.py)
+
+
+def _raw(port, method, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=None if body is None else json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    code, raw = _raw(port, "GET", path)
+    return code, json.loads(raw)
+
+
+def _post(port, path, body=None):
+    code, raw = _raw(port, "POST", path, body=body or {})
+    return code, json.loads(raw)
+
+
+def _wait_port(out_dir, timeout=120):
+    path = os.path.join(out_dir, SERVICE_JSON)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            try:
+                return json.load(open(path))["port"]
+            except (json.JSONDecodeError, KeyError):
+                pass
+        time.sleep(0.05)
+    raise TimeoutError(f"no {SERVICE_JSON} under {out_dir}")
+
+
+def _wait_health(port, pred, timeout=300):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            code, h = _get(port, "/healthz")
+        except (ConnectionError, socket.timeout,
+                http.client.HTTPException):
+            time.sleep(0.1)
+            continue
+        if code == 200 and pred(h):
+            return h
+        time.sleep(0.05)
+    raise TimeoutError("health predicate never satisfied")
+
+
+def _served(serve_call, out_dir, script):
+    box = {}
+    stale = os.path.join(out_dir, SERVICE_JSON)
+    if os.path.exists(stale):
+        os.unlink(stale)
+
+    def runner():
+        try:
+            port = _wait_port(out_dir)
+            box["result"] = script(port)
+        except BaseException as e:      # noqa: BLE001 - reraised below
+            box["error"] = e
+        finally:
+            try:
+                _post(_wait_port(out_dir), "/v1/admin/shutdown")
+            except Exception:
+                pass
+    t = threading.Thread(target=runner, daemon=True, name="test-client")
+    t.start()
+    rc = serve_call()
+    t.join(timeout=60)
+    if "error" in box:
+        raise box["error"]
+    assert not t.is_alive(), "client thread wedged"
+    return rc, box.get("result")
+
+
+# ---------------------------------------------------------------------------
+# Identity oracle: rebuild the snapshot's world and run the FULL derive
+
+
+def _oracle(snap: Snapshot) -> Snapshot:
+    """A fresh Snapshot over the same arrays, fully derived.  Using
+    ``failed = removed`` reproduces live/removed exactly (removed =
+    started & failed, and unstarted rows are dead either way)."""
+    o = Snapshot(snap.tick, snap.n, snap.tfail,
+                 started=snap.started, in_group=snap.in_group,
+                 failed=snap.removed, self_hb=snap.self_hb,
+                 view=snap._view, view_ts=snap._view_ts)
+    assert np.array_equal(o.live, snap.live)
+    assert np.array_equal(o.removed, snap.removed)
+    o._derive()
+    return o
+
+
+def _assert_byte_identical(snap: Snapshot, tag="") -> None:
+    o = _oracle(snap)
+    assert o.census_json() == snap.census_json(), tag
+    for name in ("known_by", "suspected_by", "best_hb", "staleness"):
+        assert np.array_equal(getattr(snap, name),
+                              getattr(o, name)), (tag, name)
+    assert np.array_equal(snap.suspected, o.suspected), tag
+    for i in range(snap.n):
+        assert snap.member(i) == o.member(i), (tag, i)
+
+
+class _World:
+    """A synthetic packed-view world that can evolve adversarially:
+    heartbeat churn in a few rows, liveness flips, and content churn
+    in rows that are dead on both sides of a boundary (invisible to
+    every derived stat by the dirty-row contract)."""
+
+    def __init__(self, n, s, tfail, seed):
+        rng = np.random.default_rng(seed)
+        self.n, self.s, self.tfail, self.rng = n, s, tfail, rng
+        self.tick = 6
+        self.started = np.ones(n, bool)
+        self.started[0] = False              # dead forever, both sides
+        self.in_group = np.ones(n, bool)
+        self.failed = np.zeros(n, bool)
+        self.self_hb = rng.integers(0, self.tick + 1, n)
+        member = rng.integers(0, n, (n, s))
+        hb = rng.integers(0, self.tick + 1, (n, s))
+        self.view = (member + n * hb + 1).astype(np.uint32)
+        self.view[rng.random((n, s)) < 0.12] = 0     # empty cells
+        self.view_ts = rng.integers(0, self.tick + 1,
+                                    (n, s)).astype(np.int32)
+
+    def snap(self) -> Snapshot:
+        return Snapshot(self.tick, self.n, self.tfail,
+                        started=self.started.copy(),
+                        in_group=self.in_group.copy(),
+                        failed=self.failed.copy(),
+                        self_hb=self.self_hb.copy(),
+                        view=self.view.copy(),
+                        view_ts=self.view_ts.copy())
+
+    def _churn_row(self, r):
+        rng, n = self.rng, self.n
+        cols = rng.integers(0, self.s, 3)
+        m = rng.integers(0, n, 3)
+        hb = rng.integers(max(self.tick - 6, 0), self.tick + 1, 3)
+        self.view[r, cols] = (m + n * hb + 1).astype(np.uint32)
+        self.view_ts[r, cols] = rng.integers(
+            max(self.tick - 6, 0), self.tick + 1, 3)
+
+    def step(self):
+        rng = self.rng
+        self.tick += int(rng.integers(1, 5))
+        for r in rng.integers(1, self.n, int(rng.integers(1, 5))):
+            self._churn_row(int(r))
+        if rng.random() < 0.5:      # liveness flip (fail or recover)
+            i = int(rng.integers(1, self.n))
+            self.failed[i] = not self.failed[i]
+        self._churn_row(0)          # dead-in-both churn: invisible
+
+
+@pytest.mark.parametrize("n", [64, 48])     # pow2 and divmod unpack
+def test_incremental_derive_matches_full_oracle(n):
+    w = _World(n, 8, tfail=4, seed=n)
+    prev = w.snap()
+    # First snapshot has no predecessor: incremental refuses, full runs.
+    assert prev.derive_incremental(None) is False
+    prev.precompute(None)
+    assert prev.derive_info["mode"] == "full"
+    _assert_byte_identical(prev, "first")
+    saw_delta = False
+    for step in range(14):
+        w.step()
+        cur = w.snap()
+        cur.precompute(prev)
+        assert cur.derive_info["mode"] == "delta", step
+        saw_delta = True
+        _assert_byte_identical(cur, f"step {step}")
+        prev = cur
+    assert saw_delta
+    # Guard: a snapshot OLDER than its predecessor refuses the delta
+    # path (clock went backwards across a resume) and full-derives.
+    stale = w.snap()
+    stale.tick = prev.tick - 1
+    assert stale.derive_incremental(prev) is False
+    stale.precompute(prev)
+    assert stale.derive_info["mode"] == "full"
+
+
+# ---------------------------------------------------------------------------
+# Shm ring: roundtrip, delta row accounting, seqlock, unlink
+
+
+def test_shm_ring_roundtrip_delta_and_seqlock():
+    n, s, tfail = 16, 4, 4
+    w = _World(n, s, tfail, seed=7)
+    w.started[:] = True             # all live: planes compare exactly
+    snaps = [w.snap()]
+    for r in (2, 5, 9):             # one churned row per boundary
+        w.tick += 2
+        w._churn_row(r)
+        snaps.append(w.snap())
+    prev = None
+    for sn in snaps:
+        sn.precompute(prev)
+        prev = sn
+
+    with pytest.raises(ValueError, match=">= 2 slots"):
+        shm_ring.ShmRingWriter(n, s, np.uint32, np.int32, tfail, 100, 1)
+
+    writer = shm_ring.ShmRingWriter(n, s, np.uint32, np.int32, tfail,
+                                    100, 2)
+    reader = None
+    views = []                  # released before close: the numpy
+    try:                        # views pin the shm buffer exports
+        writer.set_engine("running", 42, 3)
+        writer.publish(snaps[0], None)          # slot 0: full
+        reader = shm_ring.ShmRingReader(writer.name)
+        assert reader.newest_gen() == 2         # gen = 2 * seq
+        assert (reader.n, reader.s, reader.tfail) == (n, s, tfail)
+        assert reader.engine() == {"status": "running", "tick": 42,
+                                   "applied_events": 3}
+        v0 = reader.latest()
+        views.append(v0)
+        assert v0.tick == snaps[0].tick
+        assert v0.census == snaps[0].census_json()
+        writer.publish(snaps[1], snaps[0])      # slot 1: cold, full
+        v1 = reader.latest()
+        views.append(v1)
+        assert v1.tick == snaps[1].tick
+        assert writer.stats["rows_written"] == 2 * n
+
+        # Slot 0 again: only the union of the two boundary diffs since
+        # it last held a snapshot is rewritten — rows {2, 5}.
+        out = writer.publish(snaps[2], snaps[1])
+        assert out["rows"] == 2
+        assert writer.stats["rows_written"] == 2 * n + 2
+        assert writer.stats["bytes_written"] < writer.stats["bytes_full"]
+        v2 = reader.latest()
+        views.append(v2)
+        assert v2.tick == snaps[2].tick
+        assert v2.census == snaps[2].census_json()
+        # The zero-copy planes and derived stats are EXACT despite the
+        # partial rewrite.
+        assert np.array_equal(v2.view, snaps[2]._view)
+        assert np.array_equal(v2.view_ts, snaps[2]._view_ts)
+        for name, attr in (("known_by", "known_by"),
+                           ("suspected_by", "suspected_by"),
+                           ("best_hb", "best_hb"),
+                           ("staleness", "staleness")):
+            assert np.array_equal(v2.arrays[name],
+                                  getattr(snaps[2], attr)), name
+
+        # Seqlock: v1 (slot 1) stays valid while slot 0 is rewritten,
+        # dies when its own slot is.
+        assert v1.valid()
+        writer.publish(snaps[3], snaps[2])      # slot 1 again
+        assert not v1.valid()
+        v3 = reader.latest()
+        views.append(v3)
+        assert v3.tick == snaps[3].tick
+
+        # Torn-read detection: an odd gen means mid-write — the reader
+        # falls back to the older stable slot, then to None.
+        import struct
+        lay = writer.layout
+        g0 = reader.slot_gen(0)
+        g1 = reader.slot_gen(1)
+        struct.pack_into("<Q", writer.shm.buf, lay.slot_off(1), g1 + 1)
+        torn = reader.latest()
+        views.append(torn)
+        assert torn.tick == snaps[2].tick       # slot 0 wins
+        struct.pack_into("<Q", writer.shm.buf, lay.slot_off(0), g0 + 1)
+        assert reader.latest() is None
+        assert reader.newest_gen() == 0         # nothing stable
+        struct.pack_into("<Q", writer.shm.buf, lay.slot_off(0), g0)
+        struct.pack_into("<Q", writer.shm.buf, lay.slot_off(1), g1)
+        v4 = reader.latest()
+        views.append(v4)
+        assert v4.tick == snaps[3].tick
+    finally:
+        for v in views:
+            if v is not None:
+                v.arrays = v.view = v.view_ts = None
+        name = writer.name
+        writer.close()              # unlinks
+        assert not os.path.exists(f"/dev/shm/{name}")
+        assert shm_ring.unlink(name) is False       # idempotent
+        if reader is not None:
+            reader.close()
+
+
+# ---------------------------------------------------------------------------
+# Served grading scenarios: every published boundary byte-identical to
+# the full-rederive oracle, and ZERO derives on the engine thread
+
+
+def _spy_derives(monkeypatch):
+    """Record (thread name) of every ACTUAL derivation and every
+    published snapshot.  Census/member calls on an already-derived
+    snapshot are not derivations and are not recorded."""
+    derive_threads, published = [], []
+    orig_full = Snapshot._derive
+    orig_inc = Snapshot.derive_incremental
+    orig_pre = Snapshot.precompute
+
+    def spy_full(self):
+        if not self._derived:
+            derive_threads.append(threading.current_thread().name)
+        orig_full(self)
+
+    def spy_inc(self, prev):
+        if not self._derived and prev is not None:
+            derive_threads.append(threading.current_thread().name)
+        return orig_inc(self, prev)
+
+    def spy_pre(self, prev=None):
+        orig_pre(self, prev)
+        published.append(self)
+
+    monkeypatch.setattr(Snapshot, "_derive", spy_full)
+    monkeypatch.setattr(Snapshot, "derive_incremental", spy_inc)
+    monkeypatch.setattr(Snapshot, "precompute", spy_pre)
+    return derive_threads, published
+
+
+@pytest.mark.parametrize("scenario", ["singlefailure", "multifailure",
+                                      "msgdropsinglefailure"])
+def test_grading_identity(tmp_path, monkeypatch, scenario):
+    derive_threads, published = _spy_derives(monkeypatch)
+    conf = str(TESTDIR / f"{scenario}.conf")
+    out = tmp_path / "srv"
+    out.mkdir()
+    rc, h = _served(
+        lambda: serve_conf(conf, out_dir=str(out), seed=SEED,
+                           backend="tpu_hash", checkpoint_every=EVERY),
+        str(out),
+        lambda port: _wait_health(port,
+                                  lambda h: h["status"] == "complete"))
+    assert rc == 0
+    # The engine runs in THIS (main) thread; every derivation must have
+    # happened on the publisher thread — the engine's boundary work is
+    # O(N), never O(N*S).
+    run_derives = list(derive_threads)
+    assert run_derives and set(run_derives) == {"snapshot-publisher"}, \
+        run_derives
+    # The incremental path actually engaged (first publish is full,
+    # later boundaries delta against the published predecessor).
+    modes = [s.derive_info["mode"] for s in published]
+    assert modes[0] == "full" and "delta" in modes, modes
+    # Byte identity vs the full-rederive oracle at EVERY boundary.
+    for sn in published:
+        _assert_byte_identical(sn, f"tick {sn.tick}")
+    assert published[-1].tick == h["total"]     # chain reached the end
+
+
+# ---------------------------------------------------------------------------
+# Kill/--resume: the delta chain restarts from a full derive and stays
+# byte-identical through the stitched trajectory
+
+
+def _svc_params(tmp_path, tag, resume=0, extra=""):
+    p = Params.from_text(
+        "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+        "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 120\n"
+        "FAIL_TIME: 1000\nJOIN_MODE: warm\nBACKEND: tpu_hash\n"
+        "EVENT_MODE: full\nCHECKPOINT_EVERY: 30\nTELEMETRY: scalars\n"
+        + extra)
+    p.CHECKPOINT_DIR = str(tmp_path / f"{tag}_ck")
+    p.TELEMETRY_DIR = str(tmp_path / f"{tag}_tl")
+    p.SERVICE_PORT = 0
+    p.RESUME = resume
+    p.validate()
+    return p
+
+
+_EVENT = {"kind": "crash", "time": 70, "nodes": [3]}
+
+
+def _gate_boundaries(monkeypatch):
+    from distributed_membership_tpu.service import daemon
+
+    gates = {0: threading.Event(), 30: threading.Event()}
+    orig = daemon._make_hook
+
+    def make_gated(state):
+        hook = orig(state)
+
+        def gated(carry, tick):
+            upd = hook(carry, tick)
+            gate = gates.get(tick)
+            if gate is not None:
+                gate.wait(timeout=120)
+            return upd
+        return gated
+    monkeypatch.setattr(daemon, "_make_hook", make_gated)
+    return gates
+
+
+def test_kill_resume_identity_chain(tmp_path, monkeypatch):
+    derive_threads, published = _spy_derives(monkeypatch)
+    gates = _gate_boundaries(monkeypatch)
+    p = _svc_params(tmp_path, "kr")
+    out = tmp_path / "kr"
+    out.mkdir()
+
+    def interrupt_script(port):
+        try:
+            _wait_health(port, lambda h: h["snapshot_tick"] is not None)
+            code, reply = _post(port, "/v1/events", _EVENT)
+            assert code == 202 and reply["apply_at_tick"] == 30, reply
+            gates[0].set()
+            _wait_health(port, lambda h: h["snapshot_tick"] == 30)
+            signal.raise_signal(signal.SIGTERM)
+            return reply
+        finally:
+            for g in gates.values():    # never leave the engine parked
+                g.set()
+
+    rc, _ = _served(lambda: serve_run(p, seed=SEED, out_dir=str(out)),
+                    str(out), interrupt_script)
+    assert rc == 0
+
+    # Resume (gates stay open): a fresh publisher has no predecessor —
+    # its first publish must fall back to the full derive, then go
+    # incremental again.
+    n_before = len(published)
+    pr = _svc_params(tmp_path, "kr", resume=1)
+
+    def resume_script(port):
+        h = _wait_health(port, lambda h: h["status"] == "complete")
+        assert h["applied_events"] == 1
+        return _get(port, "/v1/census")[1]
+
+    rc, census = _served(
+        lambda: serve_run(pr, seed=SEED, out_dir=str(out)), str(out),
+        resume_script)
+    assert rc == 0
+    assert census["removed"] == 1       # the journaled crash applied
+    run_derives = list(derive_threads)
+    resumed = published[n_before:]
+    assert resumed, "resumed run published nothing"
+    assert resumed[0].derive_info["mode"] == "full"
+    assert any(s.derive_info["mode"] == "delta" for s in resumed)
+    assert set(run_derives) == {"snapshot-publisher"}, run_derives
+    for sn in published:
+        _assert_byte_identical(sn, f"tick {sn.tick}")
+    assert published[-1].tick == 120
+
+
+# ---------------------------------------------------------------------------
+# Replica pool end-to-end (heavyweight: slow tier)
+
+
+class _SSE:
+    """A raw-socket SSE subscription with incremental event parsing."""
+
+    def __init__(self, port, timeout=120):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        self.sock.sendall(b"GET /v1/stream HTTP/1.1\r\nHost: t\r\n\r\n")
+        self.buf = b""
+        while b"\r\n\r\n" not in self.buf:
+            self.buf += self.sock.recv(4096)
+        assert b"text/event-stream" in self.buf
+        self.buf = self.buf.split(b"\r\n\r\n", 1)[1]
+        self.eof = False
+
+    def read_rows(self, count, timeout=120):
+        """Parsed ``data:`` rows until ``count`` or stream end."""
+        rows = []
+        self.sock.settimeout(timeout)
+        while len(rows) < count and not self.eof:
+            while b"\n\n" in self.buf and len(rows) < count:
+                evt, self.buf = self.buf.split(b"\n\n", 1)
+                for line in evt.splitlines():
+                    if line.startswith(b"data: "):
+                        rows.append(json.loads(line[6:]))
+            if len(rows) >= count:
+                break
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                self.eof = True
+            self.buf += chunk
+        return rows
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.mark.slow
+def test_replica_pool_end_to_end(tmp_path, monkeypatch):
+    gates = _gate_boundaries(monkeypatch)
+    p = _svc_params(tmp_path, "pool",
+                    extra="SERVICE_PORT: 0\nSERVICE_WORKERS: 2\n"
+                          "SERVICE_SHM_BUFFERS: 4\n")
+    out = tmp_path / "pool"
+    out.mkdir()
+    box = {}
+
+    def _wait_replica(rport, pred, timeout=120):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                code, h = _get(rport, "/healthz")
+                if code == 200 and pred(h):
+                    return h
+            except (ConnectionError, socket.timeout,
+                    http.client.HTTPException):
+                pass
+            time.sleep(0.05)
+        raise TimeoutError("replica predicate never satisfied")
+
+    def _equal_bytes(eport, rport, paths):
+        for path in paths:
+            direct = _raw(eport, "GET", path)
+            replica = _raw(rport, "GET", path)
+            assert direct == replica, path
+
+    def script(port):
+        h = _wait_health(port, lambda h: h.get("replicas")
+                         and h.get("snapshot_tick") == 0)
+        reps = h["replicas"]
+        assert len(reps) == 2
+        box["shm"] = json.load(
+            open(os.path.join(str(out), SERVICE_JSON)))["shm"]
+        r0, r1 = reps[0]["port"], reps[1]["port"]
+        for rp in (r0, r1):
+            rh = _wait_replica(rp, lambda h: h["snapshot_tick"] == 0)
+            assert rh["role"] == "replica"
+            _equal_bytes(port, rp, ("/v1/census", "/v1/member/0",
+                                    "/v1/member/3", "/v1/member/15"))
+        # Writes stay on the engine: a replica POST is a 405 hint.
+        code, err = _post(r0, "/v1/events", _EVENT)
+        assert code == 405 and "engine daemon" in err["error"]
+
+        # SSE on both replicas, then advance one segment: rows flow
+        # from the replicas while the publisher lands a DELTA snapshot.
+        sse0, sse1 = _SSE(r0), _SSE(r1)
+        gates[0].set()
+        h = _wait_health(port, lambda h: h["snapshot_tick"] == 30)
+        assert h["derive"]["mode"] == "delta", h["derive"]
+        _wait_replica(r0, lambda h: h["snapshot_tick"] == 30)
+        _equal_bytes(port, r0, ("/v1/census", "/v1/member/3"))
+        rows = sse0.read_rows(10)
+        assert len(rows) == 10
+
+        # SIGKILL replica 1 mid-stream: its stream ends cleanly, the
+        # sibling and the engine publisher are untouched.
+        os.kill(reps[1]["pid"], signal.SIGKILL)
+        try:
+            leftover = sse1.read_rows(10 ** 6, timeout=30)
+            assert sse1.eof, "killed replica's stream neither closed " \
+                             "nor reset"
+            assert len(leftover) <= 30      # never more than flushed
+        except OSError:
+            pass                    # RST is as clean as EOF here
+        sse1.close()
+        assert _get(port, "/healthz")[0] == 200
+        assert _get(r0, "/healthz")[0] == 200
+
+        # Run to completion across more delta publications; the
+        # surviving replica streams every remaining row then sees the
+        # terminal status via the ring's engine fields.
+        gates[30].set()
+        h = _wait_health(port, lambda h: h["status"] == "complete")
+        rest = sse0.read_rows(10 ** 6)
+        assert len(rows) + len(rest) == h["total"]
+        assert sse0.eof
+        sse0.close()
+        _wait_replica(r0, lambda h: h["status"] == "complete"
+                      and h["snapshot_tick"] == 120)
+        _equal_bytes(port, r0, ("/v1/census", "/v1/member/3"))
+        # Beacons landed next to the run for run_report --watch (the
+        # writer refreshes once per BEACON_INTERVAL_S — poll past it).
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                b = json.load(
+                    open(os.path.join(str(out), "replica_0.json")))
+                if b["role"] == "replica" and b["queries"] > 0:
+                    break
+            except (OSError, ValueError):
+                pass
+            assert time.monotonic() < deadline, "beacon never counted " \
+                                                "the served queries"
+            time.sleep(0.1)
+        return reps
+
+    rc, reps = _served(lambda: serve_run(p, seed=SEED,
+                                         out_dir=str(out)),
+                       str(out), script)
+    assert rc == 0
+    # Pool shutdown unlinked the ring (no /dev/shm leak) and reaped
+    # every replica, including the SIGKILLed one.
+    assert not os.path.exists(f"/dev/shm/{box['shm']}")
+    for r in reps:
+        with pytest.raises(ProcessLookupError):
+            os.kill(r["pid"], 0)
+
+
+@pytest.mark.slow
+def test_daemon_sigkill_unlinks_ring(tmp_path):
+    """SIGKILL the daemon process outright: the replicas' stdin-EOF
+    watcher must unlink the shm ring and exit — no /dev/shm leak, no
+    orphan processes."""
+    conf = tmp_path / "kill.conf"
+    conf.write_text(
+        "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+        "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nTOTAL_TIME: 100000\n"
+        "FAIL_TIME: 1000\nJOIN_MODE: warm\nBACKEND: tpu_hash\n"
+        "EVENT_MODE: full\nCHECKPOINT_EVERY: 50\nTELEMETRY: off\n"
+        "SERVICE_WORKERS: 2\nSERVICE_SHM_BUFFERS: 4\n")
+    out = tmp_path / "out"
+    out.mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    log = open(tmp_path / "daemon.log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_membership_tpu", str(conf),
+         "--serve", "--port", "0", "--out-dir", str(out)],
+        env=env, cwd=str(tmp_path), stdout=log,
+        stderr=subprocess.STDOUT)
+    log.close()
+    pids, shm = [], None
+    try:
+        deadline = time.monotonic() + 240
+        info = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "daemon died early: "
+                    + open(tmp_path / "daemon.log").read())
+            try:
+                info = json.load(open(out / SERVICE_JSON))
+                if info.get("replicas") and info.get("shm"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        assert info and info.get("shm"), "daemon never spawned the pool"
+        shm = info["shm"]
+        pids = [r["pid"] for r in info["replicas"]]
+        assert os.path.exists(f"/dev/shm/{shm}")
+
+        proc.kill()                 # SIGKILL: no cleanup path runs
+        proc.wait(timeout=30)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except ProcessLookupError:
+                    pass
+            if not alive and not os.path.exists(f"/dev/shm/{shm}"):
+                return              # leak-free: the acceptance pin
+            time.sleep(0.2)
+        raise AssertionError(
+            f"leak after daemon SIGKILL: replicas alive={alive}, "
+            f"ring present={os.path.exists(f'/dev/shm/{shm}')}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if shm:
+            shm_ring.unlink(shm)
+
+
+# ---------------------------------------------------------------------------
+# Fleet proxy: replica routing + failover (stub upstreams, no engine)
+
+
+class _StubHandler(http.server.BaseHTTPRequestHandler):
+    def _reply(self):
+        body = json.dumps({"who": self.server.tag,
+                           "path": self.path}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = _reply
+    do_POST = _reply
+
+    def log_message(self, *a):       # noqa: ARG002 - silence
+        pass
+
+
+def _stub(tag):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _StubHandler)
+    srv.tag = tag
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_fleet_proxy_replica_failover(tmp_path):
+    from distributed_membership_tpu.fleet.daemon import (
+        FleetState, make_fleet_server)
+    from distributed_membership_tpu.fleet.registry import Registry
+    from distributed_membership_tpu.fleet.scheduler import Scheduler
+
+    registry = Registry(str(tmp_path))
+    rec = registry.submit(
+        "MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+        "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 1000\n"
+        "JOIN_MODE: warm\nBACKEND: tpu_hash\nEVENT_MODE: full\n"
+        "CHECKPOINT_EVERY: 30\nTELEMETRY: scalars\nTOTAL_TIME: 120\n",
+        run_id="q0")
+    registry.set_state(rec, "running")
+    lock = threading.Lock()
+    scheduler = Scheduler(registry, 1, lock)
+    state = FleetState(registry, scheduler, lock)
+    engine = _stub("engine")
+    replica = _stub("replica")
+    eport = engine.server_address[1]
+    rport = replica.server_address[1]
+    dead1, dead2 = _dead_port(), _dead_port()
+    scheduler.worker_port = lambda rid: eport
+    replicas = [dead1, rport]
+    scheduler.replica_ports = lambda rid: list(replicas)
+    server = make_fleet_server(state, 0)
+    state.port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        fport = state.port
+        # A dead replica fails over to the survivor — never the
+        # engine while a replica can answer, whatever the rotation.
+        for _ in range(4):
+            code, doc = _get(fport, "/v1/runs/q0/v1/census")
+            assert code == 200 and doc["who"] == "replica", doc
+            assert doc["path"] == "/v1/census"
+        # /v1/member/<id> is replica-routed too.
+        code, doc = _get(fport, "/v1/runs/q0/v1/member/3")
+        assert code == 200 and doc["who"] == "replica"
+        # /healthz means the RUN's health: always the engine.
+        code, doc = _get(fport, "/v1/runs/q0/healthz")
+        assert code == 200 and doc["who"] == "engine"
+        # Writes always go to the engine.
+        code, doc = _post(fport, "/v1/runs/q0/v1/events", _EVENT)
+        assert code == 200 and doc["who"] == "engine"
+        # Whole pool dead -> engine answers the read.
+        replicas[:] = [dead1, dead2]
+        code, doc = _get(fport, "/v1/runs/q0/v1/census")
+        assert code == 200 and doc["who"] == "engine"
+        # Everything dead -> 502, not a hang or a traceback.
+        engine.shutdown()
+        scheduler.worker_port = lambda rid: dead2
+        code, doc = _get(fport, "/v1/runs/q0/v1/census")
+        assert code == 502 and "did not answer" in doc["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        replica.shutdown()
+        replica.server_close()
+        engine.server_close()
+
+
+# ---------------------------------------------------------------------------
+# run_report --watch: query-tier rows from replica beacons
+
+
+def test_run_report_query_tier_rows(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+
+    live = {"role": "replica", "index": 0, "pid": 1, "port": 4001,
+            "queries": 500, "qps": 120.5, "p50_ms": 0.4, "p99_ms": 1.9,
+            "snapshot_tick": 90, "snapshot_gen": 4, "engine_tick": 95,
+            "tick_lag": 5, "engine_status": "running",
+            "time": time.time()}
+    stale = dict(live, index=1, port=4002, qps=999.0, tick_lag=50,
+                 time=time.time() - 3600)
+    (tmp_path / "replica_0.json").write_text(json.dumps(live))
+    (tmp_path / "replica_1.json").write_text(json.dumps(stale))
+    # A beacon-shaped file that isn't one is ignored.
+    (tmp_path / "replica_2.json").write_text("{not json")
+
+    report = run_report.build_report(str(tmp_path))
+    qt = report["query_tier"]
+    assert len(qt["replicas"]) == 2
+    # Stale beacons (dead replica's last write) are excluded from the
+    # aggregates but still listed.
+    assert qt["qps_total"] == 120.5
+    assert qt["tick_lag_max"] == 5
+    assert qt["replicas"][1]["stale"] is True
+    md = run_report.render_markdown(report)
+    assert "Query tier (read replicas)" in md
+    assert "120.5" in md and "stale" in md

@@ -525,7 +525,9 @@ def test_params_identity_excludes_service_keys():
             "JOIN_MODE: warm\nBACKEND: tpu_hash\nCHECKPOINT_EVERY: 25\n")
     p1 = Params.from_text(base)
     p2 = Params.from_text(base + "SERVICE_PORT: 8080\n"
-                                 "SERVICE_SNAPSHOT_EVERY: 4\n")
+                                 "SERVICE_SNAPSHOT_EVERY: 4\n"
+                                 "SERVICE_WORKERS: 2\n"
+                                 "SERVICE_SHM_BUFFERS: 8\n")
     assert ck.params_identity(p1) == ck.params_identity(p2)
 
 
