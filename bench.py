@@ -972,6 +972,76 @@ def leg_hash(n: int, ticks: int, pin: str | None,
                 100 * (walls["hist"] - walls["base"])
                 / max(walls["base"], 1e-9), 1),
         })
+    # BENCH_CHAOS=1: price a chaos-campaign schedule riding the scan —
+    # the same leg re-timed with a representative fuzzed gray schedule
+    # (chaos/fuzz.py: crash/restart churn + a hard one-way blackhole +
+    # a delay window) compiled onto the general scenario tensor path.
+    # Interleaved best-of-R like the telemetry legs: the delta is the
+    # per-run overhead a campaign (scripts/chaos_campaign.py) pays over
+    # the clean protocol at the same geometry.
+    if os.environ.get("BENCH_CHAOS", "0") not in ("", "0"):
+        import tempfile
+
+        from distributed_membership_tpu.chaos.fuzz import (
+            CampaignSpec, dump_schedule, fuzz_schedule)
+        from distributed_membership_tpu.runtime.failures import resolve_plan
+        spec = CampaignSpec(seed=0, schedules=1, n=n, total=ticks,
+                            tfail=max(3, ticks // 10),
+                            tremove=max(4, ticks // 6), events=3,
+                            mix={"crash": 1.0, "one_way_flake": 1.0,
+                                 "delay_window": 1.0}, name="bench")
+        try:
+            sch = fuzz_schedule(spec, 0)
+        except ValueError as e:
+            raise SystemExit(f"BENCH_CHAOS needs a larger tick budget "
+                             f"at --ticks {ticks}: {e}")
+        reps = int(os.environ.get("BENCH_CHAOS_REPS", "3"))
+        fd, spath = tempfile.mkstemp(suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(dump_schedule(sch))
+            try:
+                # resolve_plan, NOT make_plan: make_plan ignores
+                # SCENARIO, so it would price the legacy multi-failure
+                # plan (~3x the clean scan) instead of the schedule.
+                params_chaos = Params.from_text(
+                    params_text + f"SCENARIO: {spath}\n")
+                plan_chaos = resolve_plan(params_chaos,
+                                          _pyrandom.Random("app:0"))
+            except ValueError as e:
+                raise SystemExit(f"BENCH_CHAOS: {e}")
+            # The schedule's one_way_flake arms the drop-coin RNG
+            # streams; that cost belongs to "running with loss", so the
+            # honest machinery number compares against a DROP-MATCHED
+            # baseline (conf drops over the flake's window), exactly as
+            # the BENCH_SCENARIO flake arm does.
+            flake = next(ev for ev in sch["events"]
+                         if ev["kind"] == "one_way_flake")
+            params_droppy = Params.from_text(
+                params_text.replace("DROP_MSG: 0", "DROP_MSG: 1")
+                .replace("MSG_DROP_PROB: 0", "MSG_DROP_PROB: 0.05")
+                + f"DROP_START: {flake['start']}\n"
+                f"DROP_STOP: {flake['stop']}\n")
+            plan_droppy = make_plan(params_droppy,
+                                    _pyrandom.Random("app:0"))
+            walls = _interleaved_best(
+                run_scan, ticks, (params, plan),
+                {"droppy": (params_droppy, plan_droppy),
+                 "chaos": (params_chaos, plan_chaos)}, reps, wall)
+        finally:
+            os.unlink(spath)
+        ckpt_fields.update({
+            "chaos_events": len(sch["events"]),
+            "chaos_wall_seconds": round(walls["chaos"], 3),
+            "chaos_overhead_pct": round(
+                100 * (walls["chaos"] - walls["base"])
+                / max(walls["base"], 1e-9), 1),
+            "chaos_droppy_baseline_wall_seconds": round(
+                walls["droppy"], 3),
+            "chaos_overhead_vs_droppy_pct": round(
+                100 * (walls["chaos"] - walls["droppy"])
+                / max(walls["droppy"], 1e-9), 1),
+        })
     # BENCH_FPROBE=1: price the fused probe/agg traversal
     # (ops/fused_probe) against the unfused probe pipeline at this leg's
     # geometry — interleaved best-of-R like the telemetry legs, because

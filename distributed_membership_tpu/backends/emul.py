@@ -324,7 +324,13 @@ def run_emul(params: Params, log: Optional[EventLog] = None,
     for t in range(total):
         params.globaltime = t
         for i in range(n):                      # pass 1: receive
-            if t > starts[i] and not nodes[i].failed:
+            # delay_window: a covered node skips its receive pass — its
+            # messages stay queued in net.buff and drain the first tick
+            # after the window (EN_BUFFSIZE overflow during the hold is
+            # honest bounded-queue behavior).  The node still acts in
+            # pass 2: asymmetric gray failure, not isolation.
+            if (t > starts[i] and not nodes[i].failed
+                    and (host is None or not host.delayed(t, i))):
                 nodes[i].recv_loop(t)
         for i in range(n - 1, -1, -1):          # pass 2: start / act
             if t == starts[i]:

@@ -682,7 +682,8 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # (tests/test_hlo_census.py pins op-count identity).
         if scenario is not None:
             from distributed_membership_tpu.scenario.compile import (
-                cross_group, cuts_at, site_drop_prob, updown_masks)
+                cross_group, cuts_at, delayed_mask, site_drop_prob,
+                updown_masks)
             scn = inputs[7]
             intro_v = jnp.full((n,), intro, I32)
             if scenario.has_updown:
@@ -778,6 +779,17 @@ def make_step(cfg: HashConfig, dynamic_knobs: bool = False):
         # is collision-free, and an occupant whose slot the gossip winner
         # contends for still gets its refresh.
         recv_mask = state.started & (t > start_ticks) & ~state.failed
+        if scenario is not None and scenario.n_delays:
+            # delay_window: inbound delivery to covered nodes is HELD —
+            # the node neither admits mail nor flushes pending recvs
+            # while a window covers it (mail max-merges across the held
+            # ticks, absorbing reorder; everything drains the first tick
+            # after the window).  Acks landing in the window are lost,
+            # not delayed (the one-shot expected-ack candidates are not
+            # in the carry).  ``act`` below is derived independently of
+            # this mask, so the node keeps sending, probing, and aging
+            # its TFAIL/TREMOVE sweep — asymmetric gray failure.
+            recv_mask = recv_mask & ~delayed_mask(scn, t, idx)
         rcol = recv_mask[:, None]
 
         if not ring:

@@ -445,8 +445,8 @@ def make_folded_step(cfg):
         # contract keeps the two trajectories bit-exact) ----
         if scenario is not None:
             from distributed_membership_tpu.scenario.compile import (
-                base_drop_prob, cross_group, cuts_at, site_drop_prob,
-                updown_masks)
+                base_drop_prob, cross_group, cuts_at, delayed_mask,
+                site_drop_prob, updown_masks)
             scn = inputs[7]
             if scenario.has_updown:
                 down_now, up_now = updown_masks(scn, t, idx)
@@ -460,6 +460,15 @@ def make_folded_step(cfg):
             scn = fails_now = None
 
         recv_mask = state.started & (t > start_ticks) & ~state.failed
+        act_base = recv_mask
+        if scenario is not None and scenario.n_delays:
+            # delay_window (tpu_hash.make_step's twin): delivery to
+            # covered nodes is held — mail max-merges across held ticks
+            # and drains after the window.  ``act`` derives from the
+            # PRE-gate mask (act_base): in the natural twin act comes
+            # from started/failed/in_group independently of the gated
+            # recv_mask, so the folded act must not pick up the gate.
+            recv_mask = recv_mask & ~delayed_mask(scn, t, idx)
         rcol = rep(recv_mask)
         telem_dropped = []      # TELEMETRY scalars only (guarded below)
 
@@ -474,7 +483,7 @@ def make_folded_step(cfg):
         pending_recv = jnp.where(recv_mask, 0, state.pending_recv)
 
         # ---- self refresh (warm: join machinery is inert, omitted) ----
-        act = recv_mask & state.in_group
+        act = act_base & state.in_group
         own_hb = state.self_hb + 1
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \
@@ -890,8 +899,8 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         # elementwise — no collectives added) ----
         if scenario is not None:
             from distributed_membership_tpu.scenario.compile import (
-                base_drop_prob, cross_group, cuts_at, site_drop_prob,
-                updown_masks)
+                base_drop_prob, cross_group, cuts_at, delayed_mask,
+                site_drop_prob, updown_masks)
             scn = inputs[7]
             if scenario.has_updown:
                 down_now, up_now = updown_masks(scn, t, lrows)
@@ -905,6 +914,14 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
             scn = fails_now = None
 
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        act_base = recv_mask
+        if scenario is not None and scenario.n_delays:
+            # delay_window on local rows (see the single-shard folded
+            # twin): gate delivery only; ``act`` keeps the pre-gate
+            # mask.  The xbuf head-merge below still lands held wire
+            # mail into the carry (mail_cleared preserves it), so
+            # nothing is lost across the window.
+            recv_mask = recv_mask & ~delayed_mask(scn, t, lrows)
         rcol = rep(recv_mask)
         telem_dropped = []      # TELEMETRY scalars only (guarded below)
 
@@ -926,7 +943,7 @@ def make_ring_sharded_folded_step(cfg, n_local: int, n_shards: int,
         pending_recv = jnp.where(recv_mask, 0, pend_eff)
 
         # ---- self refresh (warm: join machinery inert) ----
-        act = recv_mask & state.in_group
+        act = act_base & state.in_group
         own_hb = state.self_hb + 1
         self_hb = jnp.where(act, state.self_hb + 2, state.self_hb)
         self_val = jnp.where(act, own_hb, 0).astype(U32) * U32(n) \

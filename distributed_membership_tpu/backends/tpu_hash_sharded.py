@@ -414,8 +414,8 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         # every consulting site below do not exist in the program.
         if scenario is not None:
             from distributed_membership_tpu.scenario.compile import (
-                base_drop_prob, cross_group, cuts_at, site_drop_prob,
-                updown_masks)
+                base_drop_prob, cross_group, cuts_at, delayed_mask,
+                site_drop_prob, updown_masks)
             scn = inputs[7]
             if scenario.has_updown:
                 down_now, up_now = updown_masks(scn, t, lrows)
@@ -432,6 +432,14 @@ def make_ring_sharded_step(cfg: HashConfig, n_local: int, n_shards: int,
         # (ops/fused_receive: receive_core, or its Pallas twin when
         # cfg.fused_receive — identical math, tpu_hash.make_step ring.)
         recv_mask = state.started & (t > start_ticks_l) & ~state.failed
+        if scenario is not None and scenario.n_delays:
+            # delay_window on local rows (tpu_hash.make_step's gate):
+            # inbound delivery held — mail max-merges across the held
+            # ticks (the xbuf head-merge below still lands in the
+            # preserved carry), pending recvs flush after the window.
+            # ``act`` below derives independently, so the node keeps
+            # sending/probing and aging its sweep.
+            recv_mask = recv_mask & ~delayed_mask(scn, t, lrows)
         rcol = recv_mask[:, None]
 
         def wf_now():

@@ -1,0 +1,31 @@
+"""Chaos campaigns: seeded scenario fuzzing, oracle-gated sweeps,
+auto-shrunk regression repros.
+
+The scenario engine (scenario/) made chaos schedules *declarative*; the
+oracle (scenario/oracle.py) made grading *mechanical* (hard invariant
+verdicts).  This package closes the loop and makes chaos *cheap to run
+in bulk*:
+
+  * :mod:`.fuzz` — a seeded fuzzer that turns a campaign spec (seed,
+    schedule count, N, tick budget, event-mix weights) into
+    random-but-valid scenario JSON over the full event vocabulary.
+    Every schedule in a campaign shares one
+    :class:`..scenario.compile.ScenarioStatic` (fixed per-kind event
+    counts), so a whole campaign pays ONE jitted compile.
+  * :mod:`.campaign` — the runner: fans schedules out in-process or as
+    fleet submissions (sweeps/fleet_submit.py plumbing), grades every
+    run with the oracle's invariant verdicts, and journals per-run
+    verdicts into a torn-tolerant ``campaign.jsonl`` that
+    ``scripts/run_report.py --watch`` renders live.
+  * :mod:`.shrink` — deterministic delta debugging of violating
+    schedules down to a minimal repro, banked with its seed + campaign
+    digest so the bug reproduces from the JSON alone.
+"""
+
+from distributed_membership_tpu.chaos.fuzz import (        # noqa: F401
+    CampaignSpec, campaign_digest, dump_schedule, fuzz_schedule,
+    kind_counts, schedule_digest)
+from distributed_membership_tpu.chaos.campaign import (    # noqa: F401
+    read_journal, run_campaign)
+from distributed_membership_tpu.chaos.shrink import (      # noqa: F401
+    bank_repro, shrink_schedule)

@@ -1,0 +1,268 @@
+"""Campaign runner: fan fuzzed schedules out, grade, journal, shrink.
+
+A campaign is ``spec.schedules`` runs of the SAME conf under different
+fuzzed chaos schedules (chaos/fuzz.py), each graded by the scenario
+oracle's hard invariant verdicts (scenario/oracle.py).  Two execution
+modes share the grading and journaling tail:
+
+  * **inproc** — every run executes in this process through the jitted
+    backend runner.  Because the fuzzer holds ``ScenarioStatic`` fixed
+    across the campaign, the whole sweep pays ONE compile; this is the
+    CI tier (tests/test_chaos.py runs an 8-schedule campaign inside the
+    slow-budget audit).
+  * **fleet** — schedules ship inline to a ``--fleet`` controller
+    (sweeps/fleet_submit.py: retrying submit, terminal-state wait) and
+    verdicts are graded from each run dir's ``scenario.json`` oracle
+    report (the worker's finish_run writes it — the controller's
+    ring-family workers always run with ``--telemetry-dir``).
+
+Every graded run appends one line to ``campaign.jsonl`` — write +
+flush + fsync per line, so a reader (scripts/run_report.py --watch) or
+a crashed campaign never sees more than one torn line, and
+:func:`read_journal` skips it.  Violating schedules are delta-debugged
+to a minimal repro (chaos/shrink.py) and banked with the campaign
+digest + seed; the journal records the shrink start and the banked
+path, so a watcher shows "currently shrinking" honestly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional
+
+from distributed_membership_tpu.chaos.fuzz import (
+    CampaignSpec, campaign_digest, dump_schedule, fuzz_schedule,
+    schedule_digest)
+from distributed_membership_tpu.chaos.shrink import (
+    bank_repro, shrink_schedule)
+
+#: Conf the campaign grades against; spec fields fill the blanks.
+#: tpu_hash + ring + warm join + agg events + scalar telemetry is the
+#: cheapest config that exercises the full scenario vocabulary AND
+#: records the series the oracle grades from.
+_CONF_TEMPLATE = (
+    "MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: {view}\nGOSSIP_LEN: {gossip}\nPROBES: {probes}\nFANOUT: 2\n"
+    "TFAIL: {tfail}\nTREMOVE: {tremove}\nTOTAL_TIME: {total}\n"
+    "JOIN_MODE: warm\nEVENT_MODE: agg\nEXCHANGE: ring\n"
+    "TELEMETRY: scalars\nBACKEND: tpu_hash\n")
+
+
+def base_conf(spec: CampaignSpec, overrides: Optional[dict] = None) -> str:
+    """The campaign's conf text; ``overrides`` lets a caller grade a
+    DELIBERATELY broken config (the acceptance exercise: TREMOVE <
+    TFAIL must produce violations that shrink to banked repros)."""
+    from distributed_membership_tpu.sweeps.fleet_submit import override_conf
+    view = max(4, min(16, spec.n // 2 * 2))
+    # Probe rate scaled so a full view refresh fits >= 4 times inside
+    # TREMOVE (config.py's probe-cycle floor) at any campaign N.
+    probes = max(2, -(-view * 4 // max(1, spec.tremove)))
+    conf = _CONF_TEMPLATE.format(n=spec.n, total=spec.total,
+                                 tfail=spec.tfail, tremove=spec.tremove,
+                                 view=view, gossip=max(2, view // 2),
+                                 probes=probes)
+    for k, v in sorted((overrides or {}).items()):
+        conf = override_conf(conf, k, v)
+    return conf
+
+
+class Journal:
+    """Torn-tolerant append-only JSONL (one fsynced line per event)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "a")
+
+    def append(self, obj: dict) -> None:
+        self._fh.write(json.dumps(obj, sort_keys=True) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def read_journal(path: str) -> List[dict]:
+    """Replay a ``campaign.jsonl``; torn/corrupt lines are skipped (a
+    campaign killed mid-write loses at most its last line)."""
+    rows: List[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def _grade(report: Optional[dict]) -> dict:
+    """Journal-row fields from an oracle report (None = run lost)."""
+    if not report or "invariants" not in report:
+        return {"ok": False, "violations": ["no_oracle_report"]}
+    return {
+        "ok": bool(report["ok"]),
+        "violations": list(report["violations"]),
+        "live": report.get("final", {}).get("live"),
+        "false_removals": report.get("detection_summary",
+                                     {}).get("false_removals"),
+    }
+
+
+def _run_inproc(conf_text: str, scn_path: str, seed: int) -> dict:
+    """One run through the jitted backend; -> the oracle report."""
+    from distributed_membership_tpu.backends import get_backend
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.sweeps.fleet_submit import override_conf
+    params = Params.from_text(
+        override_conf(conf_text, "SCENARIO", scn_path))
+    r = get_backend(params.BACKEND)(params, seed=seed)
+    return r.extra["scenario_report"]
+
+
+def oracle_predicate(conf_text: str, seed: int, probe_path: str,
+                     target: set) -> Callable[[dict], bool]:
+    """The shrinker's predicate: does this candidate still trip one of
+    the ORIGINAL violations?  Schema-invalid candidates (ddmin dropping
+    a crash whose restart stayed, say) count as non-violating."""
+    def violating(cand: dict) -> bool:
+        with open(probe_path, "w") as fh:
+            fh.write(dump_schedule(cand))
+        try:
+            report = _run_inproc(conf_text, probe_path, seed)
+        except ValueError:
+            return False
+        return bool(target.intersection(report["violations"]))
+    return violating
+
+
+def run_campaign(spec: CampaignSpec, out_dir: str, *,
+                 overrides: Optional[dict] = None,
+                 mode: str = "inproc",
+                 port: Optional[int] = None,
+                 fleet_root: Optional[str] = None,
+                 shrink: bool = True,
+                 bank_dir: Optional[str] = None,
+                 progress: Optional[Callable[[str], None]] = None) -> dict:
+    """Run a full campaign; -> summary dict (also journaled).
+
+    ``out_dir`` receives ``scenarios/`` (every fuzzed schedule, banked
+    as runnable JSON), ``campaign.jsonl``, and — for violations —
+    ``regressions/`` (unless ``bank_dir`` redirects the bank).
+    """
+    if mode not in ("inproc", "fleet"):
+        raise ValueError(f"mode {mode!r}: expected inproc|fleet")
+    if mode == "fleet" and (port is None or fleet_root is None):
+        raise ValueError("fleet mode needs port= and fleet_root=")
+    say = progress or (lambda s: None)
+    os.makedirs(out_dir, exist_ok=True)
+    scen_dir = os.path.join(out_dir, "scenarios")
+    os.makedirs(scen_dir, exist_ok=True)
+    conf_text = base_conf(spec, overrides)
+    digest = campaign_digest(spec)
+    journal = Journal(os.path.join(out_dir, "campaign.jsonl"))
+    journal.append({"kind": "campaign", "digest": digest, "mode": mode,
+                    "spec": spec.to_dict(),
+                    "overrides": dict(overrides or {})})
+
+    schedules, paths, seeds = [], [], []
+    for i in range(spec.schedules):
+        sch = fuzz_schedule(spec, i)
+        path = os.path.join(scen_dir, f"{sch['name']}.json")
+        with open(path, "w") as fh:
+            fh.write(dump_schedule(sch))
+        schedules.append(sch)
+        paths.append(path)
+        seeds.append(spec.seed + i)
+
+    reports: List[Optional[dict]] = []
+    if mode == "inproc":
+        for i, (sch, path, seed) in enumerate(
+                zip(schedules, paths, seeds)):
+            reports.append(_run_inproc(conf_text, path, seed))
+            _journal_graded(journal, spec, i, sch, seed, reports[-1])
+            say(f"{sch['name']}: "
+                f"{'ok' if reports[-1]['ok'] else 'VIOLATION'}")
+    else:
+        reports = _run_fleet(journal, spec, schedules, seeds, conf_text,
+                             port, fleet_root, say)
+
+    violators = [(i, r) for i, r in enumerate(reports)
+                 if not (r and r.get("ok"))]
+    repros = []
+    if shrink:
+        bank = bank_dir or os.path.join(out_dir, "regressions")
+        probe = os.path.join(out_dir, "shrink_probe.json")
+        for i, report in violators:
+            if not report or "violations" not in report:
+                continue            # lost run: nothing to shrink
+            target = set(report["violations"])
+            journal.append({"kind": "shrinking",
+                            "run_id": schedules[i]["name"],
+                            "violations": sorted(target)})
+            say(f"shrinking {schedules[i]['name']} ({sorted(target)})")
+            minimal, stats = shrink_schedule(
+                schedules[i],
+                oracle_predicate(conf_text, seeds[i], probe, target))
+            path = bank_repro(minimal, bank, {
+                "seed": seeds[i], "campaign": digest,
+                "violations": sorted(target),
+                "shrunk_from": schedule_digest(schedules[i]),
+                "probes": stats["probes"],
+                # The repro only violates under the campaign's conf —
+                # carry the deliberate breakage for self-containedness.
+                "overrides": dict(overrides or {})})
+            repros.append(path)
+            journal.append({"kind": "shrunk",
+                            "run_id": schedules[i]["name"],
+                            "path": path, "probes": stats["probes"],
+                            "events": stats["events_after"]})
+            say(f"banked {path} ({stats['events_after']} events, "
+                f"{stats['probes']} probes)")
+
+    summary = {"kind": "done", "digest": digest,
+               "runs": len(schedules),
+               "violations": [schedules[i]["name"] for i, _ in violators],
+               "repros": repros,
+               "ok": not violators}
+    journal.append(summary)
+    journal.close()
+    return summary
+
+
+def _journal_graded(journal: Journal, spec: CampaignSpec, index: int,
+                    sch: dict, seed: int, report: Optional[dict]) -> None:
+    journal.append({"kind": "graded", "run_id": sch["name"],
+                    "index": index, "seed": seed,
+                    "digest": schedule_digest(sch), **_grade(report)})
+
+
+def _run_fleet(journal: Journal, spec: CampaignSpec, schedules, seeds,
+               conf_text: str, port: int, fleet_root: str,
+               say) -> List[Optional[dict]]:
+    """Fleet fan-out: inline scenario submissions, graded from each run
+    dir's oracle report once the grid is terminal."""
+    from distributed_membership_tpu.sweeps.fleet_submit import (
+        submit_grid, wait_grid)
+    subs = [{"conf": conf_text, "run_id": sch["name"], "seed": seed,
+             "scenario": {"name": sch["name"], "events": sch["events"]}}
+            for sch, seed in zip(schedules, seeds)]
+    submit_grid(port, subs)
+    say(f"submitted {len(subs)} runs to fleet :{port}")
+    rows = wait_grid(port, [s["run_id"] for s in subs])
+    reports: List[Optional[dict]] = []
+    for i, (sch, seed) in enumerate(zip(schedules, seeds)):
+        report = None
+        if rows.get(sch["name"], {}).get("state") == "done":
+            try:
+                with open(os.path.join(fleet_root, sch["name"],
+                                       "scenario.json")) as fh:
+                    report = json.load(fh)
+            except (OSError, ValueError):
+                report = None
+        reports.append(report)
+        _journal_graded(journal, spec, i, sch, seed, report)
+    return reports

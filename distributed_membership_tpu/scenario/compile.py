@@ -60,8 +60,9 @@ class ScenarioStatic(NamedTuple):
     n_events: int         # point-event rows (crash/leave/restart ranges)
     n_parts: int          # partition windows
     n_cuts: int           # group-boundary cut columns
-    n_flakes: int         # link_flake windows
+    n_flakes: int         # link_flake / one_way_flake windows
     n_windows: int        # global drop windows
+    n_delays: int         # delay_window (hold-inbound) windows
     has_drop: bool        # any coin-consuming loss (windows or flakes)
     has_updown: bool      # any crash/leave/restart event
 
@@ -86,6 +87,10 @@ class ScenarioTensors(NamedTuple):
     dw_lo: object         # [W] i32 (pad -9)
     dw_hi: object         # [W] i32
     dw_prob: object       # [W] f32 (quantized)
+    dl_start: object      # [D] i32 (pad -9)
+    dl_stop: object       # [D] i32
+    dl_lo: object         # [D] i32 — dst range held during the window
+    dl_hi: object         # [D] i32
 
 
 def _quant(p: float) -> float:
@@ -127,6 +132,16 @@ def cross_group(cuts, src, dst):
     def grp(x):
         return (x[..., None] >= cuts).sum(-1)
     return grp(src) != grp(dst)
+
+
+def delayed_mask(scn: ScenarioTensors, t, node_ids):
+    """Bool mask shaped like ``node_ids``: which nodes have inbound
+    delivery held at tick ``t`` (any active delay window covering the
+    id).  Purely elementwise over the [D] rows — callers gate the call
+    on ``static.n_delays`` so delay-free programs stay op-identical."""
+    act = (t > scn.dl_start) & (t <= scn.dl_stop)            # [D]
+    x = node_ids[..., None]
+    return (act & (x >= scn.dl_lo) & (x < scn.dl_hi)).any(-1)
 
 
 def base_drop_prob(scn: ScenarioTensors, t):
@@ -175,6 +190,8 @@ class ScenarioProgram:
     partitions: List[dict]        # {start, stop, cuts: [..]}
     flakes: List[dict]            # {start, stop, src, dst, drop_prob}
     drop_windows: List[dict]      # {start, stop, drop_prob}
+    delays: List[dict] = dataclasses.field(default_factory=list)
+    # ^ {start, stop, dst: (lo, hi)} — hold-inbound windows
 
     _tensors: Optional[ScenarioTensors] = dataclasses.field(
         default=None, repr=False, compare=False)
@@ -230,11 +247,20 @@ class ScenarioProgram:
         for j, w in enumerate(self.drop_windows):
             dw_lo[j], dw_hi[j] = w["start"], w["stop"]
             dw_prob[j] = w["drop_prob"]
+        d = max(st.n_delays, 1)
+        dl_start = np.full((d,), -9, np.int32)
+        dl_stop = np.full((d,), -9, np.int32)
+        dl_lo = np.zeros((d,), np.int32)
+        dl_hi = np.zeros((d,), np.int32)
+        for j, w in enumerate(self.delays):
+            dl_start[j], dl_stop[j] = w["start"], w["stop"]
+            dl_lo[j], dl_hi[j] = w["dst"]
         return ScenarioTensors(
             ev_time, ev_down, ev_up, ev_lo, ev_hi,
             part_start, part_stop, part_cut,
             fl["start"], fl["stop"], fl["slo"], fl["shi"], fl["dlo"],
-            fl["dhi"], fl_prob, dw_lo, dw_hi, dw_prob)
+            fl["dhi"], fl_prob, dw_lo, dw_hi, dw_prob,
+            dl_start, dl_stop, dl_lo, dl_hi)
 
     def host(self) -> "ScenarioHost":
         return ScenarioHost(self)
@@ -275,6 +301,15 @@ class ScenarioHost:
             return False
         cuts = self._cuts(t)
         return int((src >= cuts).sum()) != int((dst >= cuts).sum())
+
+    def delayed(self, t: int, idx: int) -> bool:
+        """Whether node ``idx`` has inbound delivery held at tick ``t``
+        (host twin of :func:`delayed_mask`)."""
+        if self.program.static.n_delays == 0:
+            return False
+        tt = self._t
+        return bool(((t > tt.dl_start) & (t <= tt.dl_stop)
+                     & (idx >= tt.dl_lo) & (idx < tt.dl_hi)).any())
 
     def drop_pct(self, t: int, src: int, dst: int) -> int:
         """Effective drop percentage for one message (reference-style
@@ -351,7 +386,7 @@ def compile_scenario(scn: Scenario, params, rng, force_general: bool = False):
     n, total = params.EN_GPSZ, params.TOTAL_TIME
     validate_scenario(scn, n, total)
 
-    point, parts, flakes, windows = [], [], [], []
+    point, parts, flakes, windows, delays = [], [], [], [], []
     kind_hint = "multi"
     for ev in scn.events:
         kind = ev["kind"]
@@ -365,12 +400,19 @@ def compile_scenario(scn: Scenario, params, rng, force_general: bool = False):
             parts.append({"start": int(ev["start"]),
                           "stop": int(ev["stop"]),
                           "cuts": [int(g[0]) for g in ev["groups"][1:]]})
-        elif kind == "link_flake":
+        elif kind in ("link_flake", "one_way_flake"):
+            # one_way_flake is sugar over the (already directed) flake
+            # rows: drop_prob defaults to a hard 1.0 blackhole.
             flakes.append({"start": int(ev["start"]),
                            "stop": int(ev["stop"]),
                            "src": (int(ev["src"][0]), int(ev["src"][1])),
                            "dst": (int(ev["dst"][0]), int(ev["dst"][1])),
-                           "drop_prob": _quant(ev["drop_prob"])})
+                           "drop_prob": _quant(ev.get("drop_prob", 1.0))})
+        elif kind == "delay_window":
+            dst = ev.get("dst", (0, n))
+            delays.append({"start": int(ev["start"]),
+                           "stop": int(ev["stop"]),
+                           "dst": (int(dst[0]), int(dst[1]))})
         else:
             windows.append({"start": int(ev["start"]),
                             "stop": int(ev["stop"]),
@@ -390,7 +432,7 @@ def compile_scenario(scn: Scenario, params, rng, force_general: bool = False):
         and windows[0]["stop"] == params.DROP_STOP
         and windows[0]["drop_prob"] == params.effective_drop_prob()))
     legacy_shape = (
-        not parts and not flakes and not restarts
+        not parts and not flakes and not delays and not restarts
         and all(e["kind"] == "crash" for e in point)
         and len(crash_times) <= 1 and len(windows) <= 1
         and conf_window_ok)
@@ -447,10 +489,12 @@ def compile_scenario(scn: Scenario, params, rng, force_general: bool = False):
         n=n, n_events=n_events, n_parts=len(parts),
         n_cuts=max((len(p["cuts"]) for p in parts), default=0),
         n_flakes=len(flakes), n_windows=len(windows),
+        n_delays=len(delays),
         has_drop=bool(windows or flakes), has_updown=n_events > 0)
     program = ScenarioProgram(
         scenario=scn, n=n, static=static, point_events=point,
-        partitions=parts, flakes=flakes, drop_windows=windows)
+        partitions=parts, flakes=flakes, drop_windows=windows,
+        delays=delays)
     return FailurePlan("scenario", fail_time, permanent, None, None,
                        scenario=program)
 

@@ -38,6 +38,30 @@ Key partition metrics (per partition window ``(start, stop]``):
   * ``reconverged_tick`` — first post-heal tick with zero suspected
     entries (telemetry basis), else the last post-heal churn tick
     (event basis) — the measured re-convergence time.
+
+Invariant verdicts (``report["invariants"]`` — hard pass/fail, the
+chaos campaign's grading contract, chaos/campaign.py):
+
+  * ``no_false_removals`` — the detection summary's accuracy count
+    (removals − true detections) must be 0, UNLESS the schedule itself
+    masks liveness: partitions, restart churn (a temporarily-crashed
+    node's removals are counted "false" by the scalar accuracy metric),
+    delay windows long enough to age an entry past TFAIL, or sustained
+    heavy loss (drop_prob >= 0.5 over >= TFAIL ticks).  The excuses are
+    a deterministic function of the SCHEDULE, never of the run, so a
+    violation cannot excuse itself.
+  * ``removals_healed`` — every partition-era eviction was re-filled
+    (``unhealed_removals == 0`` per window) and the final views carry
+    zero suspected entries of live nodes: excused false removals must
+    HEAL.
+  * ``restarts_rejoined`` — every restarted node is live at the end.
+  * ``detection_slo`` — the PR-5 detection-latency SLO verdict
+    (observability/latency_dist.slo_verdict) when the run recorded the
+    hist tier's ``h_latency``; unassessed (and passing) otherwise.
+
+``report["violations"]`` lists the failing invariant names and
+``report["ok"]`` rolls them up — False means the run violated its
+schedule's contract.
 """
 
 from __future__ import annotations
@@ -95,6 +119,70 @@ def _final_state_census(final_state, params, total: int) -> dict:
         out["suspected_entries"] = int(stale.sum())
         out["present_entries"] = int(present.sum())
     return out
+
+
+def _masking_excuses(program: ScenarioProgram, params) -> list:
+    """Schedule features that legitimately cause the scalar accuracy
+    metric to count removals of live nodes (module docstring) — a
+    deterministic function of the SCHEDULE, independent of the run."""
+    excuses = []
+    if program.partitions:
+        excuses.append("partition")
+    if any(e["kind"] == "restart" for e in program.point_events):
+        excuses.append("restart_churn")
+    if any(w["stop"] - w["start"] >= params.TFAIL
+           for w in program.delays):
+        excuses.append("long_delay")
+    heavy = [w for w in program.flakes + program.drop_windows
+             if (w["drop_prob"] >= 0.5
+                 and w["stop"] - w["start"] >= params.TFAIL)]
+    if heavy:
+        excuses.append("heavy_loss")
+    return excuses
+
+
+def _invariant_verdicts(program: ScenarioProgram, params, report: dict,
+                        summary: Optional[dict],
+                        timeline: Optional[dict]) -> dict:
+    """The hard verdicts (module docstring).  Each entry carries its
+    evidence plus ``ok``; unassessable invariants (missing artifact
+    stream) pass with ``assessed: False`` — absence of evidence is not
+    a violation, and the campaign runner requires the streams it needs."""
+    inv: dict = {}
+
+    fr = None if summary is None else summary.get("false_removals")
+    excuses = _masking_excuses(program, params)
+    inv["no_false_removals"] = {
+        "count": fr, "excused_by": excuses,
+        "assessed": fr is not None,
+        "ok": fr is None or fr == 0 or bool(excuses)}
+
+    unhealed = sum(p.get("unhealed_removals", 0)
+                   for p in report.get("partitions", ()))
+    susp = report.get("final", {}).get("suspected_entries")
+    inv["removals_healed"] = {
+        "unhealed_removals": unhealed, "suspected_entries": susp,
+        "assessed": bool(report.get("partitions")) or susp is not None,
+        "ok": unhealed == 0 and not susp}
+
+    restarts = report.get("restarts", ())
+    not_back = [r for r in restarts if r.get("rejoined") is False]
+    inv["restarts_rejoined"] = {
+        "restart_events": len(restarts), "not_rejoined": len(not_back),
+        "assessed": bool(restarts),
+        "ok": not not_back}
+
+    slo = None
+    if timeline is not None and "h_latency" in timeline:
+        from distributed_membership_tpu.observability.latency_dist import (
+            slo_verdict)
+        slo = slo_verdict(timeline)
+    inv["detection_slo"] = {
+        "assessed": bool(slo) and slo.get("passed") is not None,
+        "max_cdf_deviation": (None if slo is None
+                              else slo.get("max_cdf_deviation")),
+        "ok": slo is None or slo.get("passed") is not False}
+    return inv
 
 
 def _window_sum(series, lo: int, hi: int, t0: int = 0) -> int:
@@ -193,6 +281,10 @@ def scenario_report(program: ScenarioProgram, params, *,
     for w in program.drop_windows:
         report["events"].append({"kind": "drop_window", **{
             k: w[k] for k in ("start", "stop", "drop_prob")}})
+    for w in program.delays:
+        report["events"].append({"kind": "delay_window",
+                                 "start": w["start"], "stop": w["stop"],
+                                 "dst": list(w["dst"])})
 
     if joins is not None:
         report["totals"] = {"joins_total": int(np.asarray(joins).sum()),
@@ -207,4 +299,9 @@ def scenario_report(program: ScenarioProgram, params, *,
         report["detection_summary"] = {
             k: summary[k] for k in ("detections_total", "false_removals")
             if k in summary}
+    report["invariants"] = _invariant_verdicts(program, params, report,
+                                               summary, timeline)
+    report["violations"] = sorted(
+        name for name, v in report["invariants"].items() if not v["ok"])
+    report["ok"] = not report["violations"]
     return report

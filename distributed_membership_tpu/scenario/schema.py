@@ -46,6 +46,24 @@ Event kinds:
   * ``drop_window`` — a global Bernoulli drop window, the generalization
     of the legacy DROP_MSG/[DROP_START, DROP_STOP) injection; multiple
     windows may be given (the max of the active probabilities applies).
+  * ``one_way_flake`` — asymmetric gray failure: messages from ``src``
+    range to ``dst`` range are dropped with ``drop_prob`` (default 1.0 —
+    a hard one-way blackhole) while the reverse direction flows
+    untouched.  Sugar over ``link_flake`` (which is already directed):
+    it lowers into the same flake tensor rows, so it costs no new RNG or
+    tensor machinery — only the default probability and the intent
+    differ.
+  * ``delay_window`` — gray failure by delay/reorder: for
+    ``start < t <= stop``, delivery TO nodes in the ``dst`` range (all
+    nodes when omitted) is held — inbound mail accumulates in the
+    existing max-merge mailboxes (newer heartbeats supersede older ones,
+    which is exactly reorder-absorption) and drains the first tick after
+    the window closes.  The delayed node keeps sending, probing, and
+    aging its failure-detector timers, so peers see it as healthy while
+    its own view goes stale — the classic asymmetric gray-failure
+    pressure.  Probe acks that land inside the window are lost rather
+    than delayed (the one-shot expected-ack slot has no queue; the
+    reference's EmulNet drops late acks the same way).
 
 Node selectors for crash/restart/leave (exactly one per event):
 
@@ -69,7 +87,7 @@ import json
 from typing import List
 
 EVENT_KINDS = ("crash", "restart", "leave", "partition", "link_flake",
-               "drop_window")
+               "drop_window", "one_way_flake", "delay_window")
 DRAW_KINDS = ("single", "multi", "racks")
 _POINT_KINDS = ("crash", "restart", "leave")
 
@@ -184,10 +202,14 @@ def validate_scenario(scn: Scenario, n: int, total: int) -> None:
                         f"scenario event {ev}: groups cover [0, {prev}) "
                         f"but N={n}")
                 part_spans.append((start, stop))
-            elif kind == "link_flake":
+            elif kind in ("link_flake", "one_way_flake"):
                 _check_range(ev, "src", n, kind)
                 _check_range(ev, "dst", n, kind)
-            if kind in ("link_flake", "drop_window"):
+            elif kind == "delay_window":
+                if "dst" in ev:
+                    _check_range(ev, "dst", n, kind)
+            if kind in ("link_flake", "drop_window") or (
+                    kind == "one_way_flake" and "drop_prob" in ev):
                 p = ev.get("drop_prob")
                 if not isinstance(p, (int, float)) or not 0 < p <= 1:
                     raise ValueError(

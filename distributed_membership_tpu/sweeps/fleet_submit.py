@@ -15,6 +15,11 @@ builds the cross product (3 drop rates x 2 seeds = 6 runs), submits
 each as ``<stem>-<KEY>-<value>-s<seed>``, and with ``--wait`` polls
 ``GET /v1/runs`` until every submitted run reaches a terminal state
 (exit 0 only if all are ``done``).
+
+``--scenario-dir DIR`` crosses the grid with every ``*.json`` chaos
+schedule in DIR, shipped inline in the submission body (the chaos
+campaign fan-out — chaos/campaign.py builds on these helpers).
+Transient 502s from the fleet proxy retry with exponential backoff.
 """
 
 from __future__ import annotations
@@ -68,30 +73,67 @@ def grid(conf_text: str, axes: Dict[str, Sequence],
     return subs
 
 
+def scenario_dir_subs(subs: List[dict], scenario_dir: str) -> List[dict]:
+    """Cross ``subs`` with every ``*.json`` scenario in a directory.
+
+    Each scenario payload rides the submission inline (the scheduler
+    writes it to the run dir and hands the worker ``--scenario``), so a
+    directory of fuzzer output — chaos/fuzz.py — fans out without any
+    shared-filesystem assumption between submitter and workers."""
+    paths = sorted(p for p in os.listdir(scenario_dir)
+                   if p.endswith(".json"))
+    if not paths:
+        raise ValueError(f"no *.json scenarios in {scenario_dir!r}")
+    out = []
+    for body in subs:
+        for p in paths:
+            with open(os.path.join(scenario_dir, p)) as fh:
+                payload = json.load(fh)
+            stem = os.path.splitext(p)[0]
+            out.append(dict(body, scenario=payload,
+                            run_id=f"{body['run_id']}-{stem}"))
+    return out
+
+
 def _req(port: int, method: str, path: str,
          body: Optional[dict] = None,
-         timeout: float = 30.0) -> Tuple[int, dict]:
-    conn = http.client.HTTPConnection("127.0.0.1", port,
-                                      timeout=timeout)
-    try:
-        conn.request(
-            method, path,
-            body=None if body is None else json.dumps(body),
-            headers={"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        return resp.status, json.loads(resp.read() or b"{}")
-    finally:
-        conn.close()
+         timeout: float = 30.0,
+         retries: int = 0, backoff: float = 0.25) -> Tuple[int, dict]:
+    """One HTTP round trip; a 502 from the fleet proxy (upstream worker
+    briefly unreachable — restart, resume, overloaded accept queue) is
+    TRANSIENT and retried with exponential backoff when ``retries`` > 0.
+    Anything else — including connection errors, which mean the
+    controller itself is gone — stays loud."""
+    attempt = 0
+    while True:
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request(
+                method, path,
+                body=None if body is None else json.dumps(body),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            status, obj = resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+        if status != 502 or attempt >= retries:
+            return status, obj
+        time.sleep(backoff * (2 ** attempt))
+        attempt += 1
 
 
 def submit_grid(port: int, subs: List[dict],
-                priority: int = 0) -> List[dict]:
+                priority: int = 0, retries: int = 5) -> List[dict]:
     """POST every cell; raises on the first refusal (a refused cell
-    means the grid itself is malformed — better loud than partial)."""
+    means the grid itself is malformed — better loud than partial).
+    Transient 502s retry with backoff so a proxy hiccup mid-grid does
+    not strand a half-submitted sweep."""
     acks = []
     for body in subs:
         body = dict(body, priority=priority)
-        code, obj = _req(port, "POST", "/v1/runs", body=body)
+        code, obj = _req(port, "POST", "/v1/runs", body=body,
+                         retries=retries)
         if code != 202:
             raise RuntimeError(f"fleet refused {body.get('run_id')}: "
                                f"{obj.get('error', obj)}")
@@ -135,6 +177,10 @@ def main(argv=None) -> int:
                          "cell)")
     ap.add_argument("--stem", default=None,
                     help="run-id prefix (default: conf file stem)")
+    ap.add_argument("--scenario-dir", default=None,
+                    help="submit every *.json scenario in this "
+                         "directory inline (one run per grid cell per "
+                         "scenario — chaos campaign fan-out)")
     ap.add_argument("--priority", type=int, default=0,
                     help="queue priority for the whole grid (lower "
                          "dispatches first)")
@@ -158,6 +204,8 @@ def main(argv=None) -> int:
     stem = args.stem or os.path.splitext(
         os.path.basename(args.conf))[0]
     subs = grid(conf_text, axes, seeds=seeds, stem=stem)
+    if args.scenario_dir:
+        subs = scenario_dir_subs(subs, args.scenario_dir)
     acks = submit_grid(args.port, subs, priority=args.priority)
     for ack in acks:
         print(f"fleet_submit: {ack['run_id']} -> {ack['state']} "

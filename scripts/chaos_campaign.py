@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Run a chaos campaign: fuzz schedules, fan out, grade, shrink.
+
+    # 64 seeded schedules at N=10, in-process, auto-shrinking:
+    python scripts/chaos_campaign.py --out /tmp/camp --schedules 64
+
+    # Same campaign against a deliberately broken config:
+    python scripts/chaos_campaign.py --out /tmp/broken \
+        --set TREMOVE=4 --bank scenarios/regressions
+
+    # Fleet-backed fan-out (controller from `--fleet`):
+    python scripts/chaos_campaign.py --out /tmp/camp \
+        --fleet-port 8800 --fleet-root /srv/fleet
+
+Watch progress from another terminal with
+``python scripts/run_report.py /tmp/camp --watch``.  Exit status is 0
+only if every run passed every oracle invariant.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_membership_tpu.chaos import (          # noqa: E402
+    CampaignSpec, run_campaign)
+
+
+def _parse_mix(text):
+    mix = {}
+    for part in text.split(","):
+        kind, _, w = part.partition("=")
+        if not w:
+            raise argparse.ArgumentTypeError(
+                f"{part!r}: expected kind=weight")
+        mix[kind.strip()] = float(w)
+    return mix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded chaos campaign: fuzz, run, grade, shrink")
+    ap.add_argument("--out", required=True,
+                    help="campaign dir (scenarios/, campaign.jsonl, "
+                         "regressions/)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--schedules", type=int, default=64)
+    ap.add_argument("--n", type=int, default=10)
+    ap.add_argument("--total", type=int, default=160,
+                    help="tick budget per run")
+    ap.add_argument("--tfail", type=int, default=8)
+    ap.add_argument("--tremove", type=int, default=20)
+    ap.add_argument("--events", type=int, default=6,
+                    help="events per schedule")
+    ap.add_argument("--mix", type=_parse_mix, default=None,
+                    metavar="KIND=W,KIND=W",
+                    help="event-mix weights (default: fuzz.DEFAULT_MIX)")
+    ap.add_argument("--name", default="chaos")
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="conf override (repeatable) — e.g. a "
+                         "deliberately broken TREMOVE=4")
+    ap.add_argument("--fleet-port", type=int, default=None,
+                    help="fan out to a --fleet controller instead of "
+                         "running in-process")
+    ap.add_argument("--fleet-root", default=None,
+                    help="the controller's root dir (for grading run "
+                         "artifacts)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="journal violations but skip delta debugging")
+    ap.add_argument("--bank", default=None,
+                    help="where minimal repros land (default: "
+                         "OUT/regressions)")
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    for spec_txt in args.set:
+        key, _, val = spec_txt.partition("=")
+        if not val:
+            ap.error(f"--set {spec_txt!r}: expected KEY=VALUE")
+        overrides[key.strip()] = val.strip()
+    spec = CampaignSpec(seed=args.seed, schedules=args.schedules,
+                        n=args.n, total=args.total, tfail=args.tfail,
+                        tremove=args.tremove, events=args.events,
+                        mix=args.mix, name=args.name)
+    mode = "inproc" if args.fleet_port is None else "fleet"
+    if mode == "fleet" and not args.fleet_root:
+        ap.error("--fleet-port needs --fleet-root")
+    summary = run_campaign(
+        spec, args.out, overrides=overrides, mode=mode,
+        port=args.fleet_port, fleet_root=args.fleet_root,
+        shrink=not args.no_shrink, bank_dir=args.bank,
+        progress=lambda s: print(f"chaos_campaign: {s}"))
+    print(f"chaos_campaign: {summary['runs']} runs, "
+          f"{len(summary['violations'])} violations"
+          + (f", {len(summary['repros'])} repros banked"
+             if summary["repros"] else ""))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -353,6 +353,19 @@ def scenario_census(n: int = 1 << 20, s: int = 16) -> dict:
     ]
     out["chaos"] = step_census(
         params, scenario=scenario_program(params, chaos))
+    # The widened gray-failure vocabulary: one_way_flake lowers into
+    # the SAME flake tensor rows (directed, hard drop), delay_window is
+    # a pure elementwise recv-mask gate — neither may add RNG classes
+    # beyond the drop-coin streams chaos already arms, nor any
+    # [N]-class gather/scatter.
+    gray = chaos + [
+        {"kind": "one_way_flake", "start": 42, "stop": 55,
+         "src": [0, n // 2], "dst": [n // 2, n]},
+        {"kind": "delay_window", "start": 50, "stop": 60,
+         "dst": [0, n // 4]},
+    ]
+    out["gray"] = step_census(
+        params, scenario=scenario_program(params, gray))
     return out
 
 

@@ -98,8 +98,19 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=None,
                     help="per-run wall clock limit in seconds")
     ap.add_argument("extra", nargs="*",
-                    help="extra args forwarded to every CLI invocation")
+                    help="extra args forwarded to every CLI invocation "
+                    "(put dashed args after a standalone `--`, e.g. "
+                    "`-- --scenario chaos.json`)")
+    # argparse cannot route dashed tokens into a trailing nargs="*"
+    # positional, so split at the first standalone "--" ourselves:
+    # everything after it is forwarded verbatim.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    forwarded = []
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, forwarded = argv[:cut], argv[cut + 1:]
     args = ap.parse_args(argv)
+    args.extra = args.extra + forwarded
 
     port = _free_port()
     jobs = build_commands(args, port)

@@ -278,6 +278,11 @@ def _scenario_markers(sc: dict) -> list:
         elif kind == "partition":
             out.append(f"t={ev['start']}→{ev['stop']}: **partition** "
                        "(heal at stop)")
+        elif kind == "delay_window":
+            dst = ev.get("dst")
+            where = (f"dst [{dst[0]},{dst[1]})" if dst else "all")
+            out.append(f"t={ev['start']}→{ev['stop']}: "
+                       f"**delay_window** {where} (inbound held)")
         else:
             out.append(f"t={ev['start']}→{ev['stop']}: **{kind}** "
                        f"p={ev.get('drop_prob')}")
@@ -316,6 +321,18 @@ def render_markdown(report: dict) -> str:
         if sc.get("final"):
             lines += _md_kv({f"final.{k}": v
                              for k, v in sc["final"].items()})
+        inv = sc.get("invariants")
+        if inv:
+            # Hard verdicts (scenario/oracle.py): the chaos campaign's
+            # grading contract, rendered per invariant.
+            for name, v in inv.items():
+                mark = ("FAIL" if not v.get("ok") else
+                        "pass" if v.get("assessed")
+                        else "pass (not assessed)")
+                lines += _md_kv({f"invariant.{name}": mark})
+            lines += _md_kv(
+                {"verdict": "ok" if sc.get("ok") else "VIOLATED: "
+                 + ", ".join(sc.get("violations", ()))})
         lines.append("")
     ds = report.get("detection_summary")
     if ds:
@@ -499,6 +516,66 @@ def fleet_report(root: str) -> dict:
     return {"root": root, "runs": rows}
 
 
+def is_campaign_root(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, "campaign.jsonl"))
+
+
+def campaign_report(root: str) -> dict:
+    """Progress rows replayed from a chaos campaign's journal
+    (chaos/campaign.py writes it torn-tolerantly; the replay skips any
+    torn tail line).  Read-only like fleet_report — works on a live
+    campaign AND a dead one."""
+    from distributed_membership_tpu.chaos.campaign import read_journal
+    rep: dict = {"root": root, "digest": None, "mode": None,
+                 "planned": None, "graded": 0, "violations": [],
+                 "shrinking": [], "repros": [], "done": False,
+                 "ok": None}
+    shrunk = set()
+    shrinking = []
+    for row in read_journal(os.path.join(root, "campaign.jsonl")):
+        kind = row.get("kind")
+        if kind == "campaign":
+            rep["digest"] = row.get("digest")
+            rep["mode"] = row.get("mode")
+            rep["planned"] = row.get("spec", {}).get("schedules")
+        elif kind == "graded":
+            rep["graded"] += 1
+            if not row.get("ok"):
+                rep["violations"].append(row.get("run_id"))
+        elif kind == "shrinking":
+            shrinking.append(row.get("run_id"))
+        elif kind == "shrunk":
+            shrunk.add(row.get("run_id"))
+            rep["repros"].append(row.get("path"))
+        elif kind == "done":
+            rep["done"] = True
+            rep["ok"] = row.get("ok")
+    rep["shrinking"] = [r for r in shrinking if r not in shrunk]
+    return rep
+
+
+def render_campaign(report: dict) -> str:
+    planned = report.get("planned")
+    lines = [f"# campaign {report['root']} — "
+             f"digest {report.get('digest') or '?'}"
+             + (f" ({report['mode']})" if report.get("mode") else ""),
+             f"graded {report['graded']}"
+             + (f"/{planned}" if planned else "")
+             + f"  violations {len(report['violations'])}"
+             + f"  repros {len(report['repros'])}"]
+    for rid in report["violations"]:
+        lines.append(f"  VIOLATION {rid}")
+    for rid in report["shrinking"]:
+        lines.append(f"  shrinking {rid} ...")
+    for path in report["repros"]:
+        lines.append(f"  banked {path}")
+    if report["done"]:
+        lines.append("campaign done: "
+                     + ("all invariants green" if report.get("ok")
+                        else "violations found"))
+    return "\n".join(lines)
+
+
 def render_fleet(report: dict) -> str:
     lines = [f"# fleet {report['root']} — {len(report['runs'])} "
              "run(s)"]
@@ -518,6 +595,23 @@ def render_fleet(report: dict) -> str:
     return "\n".join(lines)
 
 
+def _root_report(directory: str, fleet: bool, campaign: bool):
+    """Combined report + rendering for a directory that is a fleet
+    root, a campaign root, or both (a fleet-backed campaign pointed at
+    the same dir): campaign progress first, fleet rows alongside."""
+    report: dict = {}
+    parts = []
+    if campaign:
+        report["campaign"] = campaign_report(directory)
+        parts.append(render_campaign(report["campaign"]))
+    if fleet:
+        report["fleet"] = fleet_report(directory)
+        parts.append(render_fleet(report["fleet"]))
+    if not campaign:
+        report = report["fleet"]    # fleet-only: legacy JSON shape
+    return report, "\n\n".join(parts)
+
+
 def watch(args, iterations: int | None = None) -> int:
     """Poll-and-re-render loop (``--watch``).
 
@@ -526,14 +620,18 @@ def watch(args, iterations: int | None = None) -> int:
     """
     i = 0
     fleet = bool(args.dir) and is_fleet_root(args.dir)
+    campaign = bool(args.dir) and is_campaign_root(args.dir)
     try:
         while iterations is None or i < iterations:
-            report = (fleet_report(args.dir) if fleet else
-                      build_report(args.dir, args.ladder,
-                                   slo=args.slo))
-            text = (json.dumps(report, indent=1) if args.json
-                    else render_fleet(report) if fleet
-                    else render_markdown(report))
+            if fleet or campaign:
+                report, text = _root_report(args.dir, fleet, campaign)
+                if args.json:
+                    text = json.dumps(report, indent=1)
+            else:
+                report = build_report(args.dir, args.ladder,
+                                      slo=args.slo)
+                text = (json.dumps(report, indent=1) if args.json
+                        else render_markdown(report))
             if sys.stdout.isatty():
                 sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
             else:
@@ -601,10 +699,12 @@ def main(argv=None) -> int:
     if args.watch:
         return watch(args)
 
-    if args.dir and is_fleet_root(args.dir):
-        report = fleet_report(args.dir)
-        text = (json.dumps(report, indent=1) if args.json
-                else render_fleet(report))
+    if args.dir and (is_fleet_root(args.dir)
+                     or is_campaign_root(args.dir)):
+        report, text = _root_report(args.dir, is_fleet_root(args.dir),
+                                    is_campaign_root(args.dir))
+        if args.json:
+            text = json.dumps(report, indent=1)
         if args.out:
             with open(args.out, "w") as fh:
                 fh.write(text + "\n")

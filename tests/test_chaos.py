@@ -1,0 +1,599 @@
+"""Chaos campaign runner: fuzzer, shrinker, campaign, fleet fan-out.
+
+The robustness tier's own harness gets the same treatment as the
+protocol: deterministic pins and end-to-end acceptance.
+
+  * the fuzzer's three contracts (byte-determinism, one
+    ``ScenarioStatic`` per campaign, green-on-healthy) are pinned
+    property-style over a sweep of seeds, and a fuzzed gray schedule
+    (one-way blackhole + delay window) runs bit-exact across the
+    natural/folded hash twins;
+  * the shrinker is a pure function of (schedule, predicate): same
+    violating input, same minimal repro, same probe count — twice;
+  * the mini-campaign smoke (N=10, 8 seeded schedules, in-process) is
+    the CI tier; the 64-schedule acceptance campaign and the
+    deliberately-broken-config repro exercise ride the quick tier too
+    because the whole sweep shares ONE compile;
+  * fleet fan-out (real subprocess controller), the multi-process
+    kill/resume arm (campaign schedule riding ``--scenario`` through
+    scripts/multiproc_launch.py), and delta-replica staleness under a
+    churn schedule are the slow arms.
+"""
+
+import copy
+import json
+import os
+import pathlib
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.backends import get_backend
+from distributed_membership_tpu.chaos import (
+    CampaignSpec, bank_repro, campaign_digest, dump_schedule,
+    fuzz_schedule, kind_counts, read_journal, run_campaign,
+    schedule_digest, shrink_schedule)
+from distributed_membership_tpu.chaos.campaign import Journal, base_conf
+from distributed_membership_tpu.config import Params
+from distributed_membership_tpu.runtime import checkpoint as ck
+from distributed_membership_tpu.scenario.compile import compile_scenario
+from distributed_membership_tpu.scenario.schema import load_scenario
+from distributed_membership_tpu.sweeps import fleet_submit
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write_schedule(tmp_path, schedule, name=None):
+    path = tmp_path / f"{name or schedule['name']}.json"
+    path.write_text(dump_schedule(schedule))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# Fuzzer: determinism, one-static-per-campaign, validity
+
+
+@pytest.mark.quick
+def test_fuzz_deterministic_valid_one_static(tmp_path):
+    """Property sweep: every schedule of a campaign (a) regenerates
+    byte-identically, (b) passes schema validation via load_scenario,
+    (c) compiles on the general path to the SAME ScenarioStatic (the
+    one-compile-per-campaign contract), across two specs."""
+    specs = (CampaignSpec(),                       # defaults: N=10
+             CampaignSpec(seed=11, n=32, events=8, total=200,
+                          name="wide"))
+    for spec in specs:
+        params = Params.from_text(base_conf(spec))
+        statics = set()
+        for i in range(12):
+            sch = fuzz_schedule(spec, i)
+            assert dump_schedule(fuzz_schedule(spec, i)) == \
+                dump_schedule(sch), (spec.name, i)
+            path = _write_schedule(tmp_path, sch)
+            scn = load_scenario(path)               # schema-validates
+            plan = compile_scenario(
+                scn, params, random.Random("pin"), force_general=True)
+            statics.add(plan.scenario.static)
+        assert len(statics) == 1, (spec.name, statics)
+
+
+@pytest.mark.quick
+def test_fuzz_compiles_on_all_four_ring_twins(tmp_path):
+    """A fuzzed schedule compiles on every ring-family twin —
+    {tpu_hash, tpu_hash_sharded} x FOLDED {0, 1} — and the general-path
+    ScenarioStatic is identical across all four (static is geometry-
+    derived, so a twin swap mid-campaign cannot force a recompile)."""
+    spec = CampaignSpec(seed=7, n=16, events=5, total=160,
+                        mix={"crash": 1.0, "restart": 1.0,
+                             "one_way_flake": 1.0, "delay_window": 1.0})
+    scn = load_scenario(_write_schedule(tmp_path, fuzz_schedule(spec, 0)))
+    conf = base_conf(spec)
+    statics = set()
+    for backend in ("tpu_hash", "tpu_hash_sharded"):
+        for folded in (0, 1):
+            params = Params.from_text(
+                conf.replace("BACKEND: tpu_hash\n",
+                             f"BACKEND: {backend}\n")
+                + f"FOLDED: {folded}\n")
+            plan = compile_scenario(
+                scn, params, random.Random("pin"), force_general=True)
+            assert plan.scenario is not None, (backend, folded)
+            statics.add(plan.scenario.static)
+    assert len(statics) == 1, statics
+
+
+@pytest.mark.quick
+def test_fuzz_kind_counts_apportionment():
+    """Largest-remainder apportionment: counts sum to spec.events,
+    restarts never outnumber crashes, and the EMITTED per-kind event
+    counts match the apportionment exactly (dropping an event would
+    change ScenarioStatic mid-campaign)."""
+    spec = CampaignSpec(seed=3, n=16, events=8, total=240,
+                        mix={k: 1.0 for k in (
+                            "crash", "restart", "leave", "partition",
+                            "link_flake", "drop_window",
+                            "one_way_flake", "delay_window")})
+    counts = kind_counts(spec)
+    assert sum(counts.values()) == spec.events
+    assert counts.get("restart", 0) <= counts.get("crash", 0)
+    assert set(counts) == set(spec.mix)             # all 8 kinds, once
+    for i in range(8):
+        sch = fuzz_schedule(spec, i)
+        emitted = {}
+        for ev in sch["events"]:
+            emitted[ev["kind"]] = emitted.get(ev["kind"], 0) + 1
+        assert emitted == dict(counts), i
+    # Weight 0 drops a kind; all-zero mixes are rejected loudly.
+    assert "leave" not in kind_counts(
+        CampaignSpec(mix={"crash": 1.0, "leave": 0.0}))
+    with pytest.raises(ValueError, match="no positive weights"):
+        kind_counts(CampaignSpec(mix={"crash": 0.0}))
+
+
+@pytest.mark.quick
+def test_fuzz_digests_pinned():
+    """Digest regression pins: the campaign digest hashes the spec, the
+    schedule digest hashes the canonical bytes.  If these move, every
+    banked repro's provenance chain silently breaks — bump them only
+    with a conscious fuzzer-format change."""
+    spec = CampaignSpec()
+    assert campaign_digest(spec) == campaign_digest(CampaignSpec())
+    sch = fuzz_schedule(spec, 0)
+    assert schedule_digest(sch) == schedule_digest(fuzz_schedule(spec, 0))
+    assert sch["meta"]["campaign"] == campaign_digest(spec)
+    # Different index / different seed -> different schedules.
+    assert schedule_digest(fuzz_schedule(spec, 1)) != schedule_digest(sch)
+    assert (schedule_digest(fuzz_schedule(CampaignSpec(seed=1), 0))
+            != schedule_digest(sch))
+
+
+@pytest.mark.quick
+def test_fuzz_budget_errors():
+    """Impossible specs fail loudly upfront — never by silently
+    dropping events (which would break the one-compile contract)."""
+    with pytest.raises(ValueError, match="tick budget"):
+        fuzz_schedule(CampaignSpec(total=50), 0)
+    with pytest.raises(ValueError, match="down-event node budget|"
+                                         "disjoint down-event"):
+        fuzz_schedule(CampaignSpec(n=4, events=12,
+                                   mix={"crash": 1.0}, total=400), 0)
+
+
+@pytest.mark.quick
+def test_fuzzed_gray_schedule_twin_bit_exact(tmp_path):
+    """A fuzzed gray-failure schedule (hard one-way blackhole + delay
+    window + churn) replays bit-exact across the natural and folded
+    tpu_hash twins AND grades green: the oracle's excuse machinery
+    covers everything the fuzzer emits on a healthy protocol."""
+    spec = CampaignSpec(seed=21, n=32, total=200, events=5,
+                        mix={"crash": 1.0, "restart": 1.0,
+                             "one_way_flake": 1.5, "delay_window": 1.5},
+                        name="gray")
+    sch = fuzz_schedule(spec, 2)
+    kinds = {e["kind"] for e in sch["events"]}
+    assert {"one_way_flake", "delay_window"} <= kinds, kinds
+    spath = _write_schedule(tmp_path, sch)
+    base = base_conf(spec) + f"SCENARIO: {spath}\n"
+    r_nat = get_backend("tpu_hash")(
+        Params.from_text(base + "FOLDED: 0\n"), seed=5)
+    r_fold = get_backend("tpu_hash")(
+        Params.from_text(base + "FOLDED: 1\n"), seed=5)
+    assert np.array_equal(r_nat.sent, r_fold.sent)
+    assert (r_nat.extra["scenario_report"]
+            == r_fold.extra["scenario_report"])
+    rep = r_nat.extra["scenario_report"]
+    assert rep["ok"], rep["violations"]
+    assert set(rep["invariants"]) == {
+        "no_false_removals", "removals_healed", "restarts_rejoined",
+        "detection_slo"}
+    assert any(e["kind"] == "delay_window" for e in rep["events"])
+
+
+@pytest.mark.quick
+def test_oracle_excuses_hard_blackhole(tmp_path):
+    """A hard one-way blackhole >= TFAIL ticks causes false removals
+    the oracle EXCUSES (heavy_loss) but still requires to heal; a
+    violation can never excuse itself."""
+    spec = CampaignSpec(n=16, total=140, tfail=8, tremove=20)
+    spath = _write_schedule(tmp_path, {
+        "name": "blackhole",
+        "events": [
+            {"kind": "one_way_flake", "start": 30, "stop": 50,
+             "src": [0, 16], "dst": [0, 4]},
+            {"kind": "delay_window", "start": 60, "stop": 64,
+             "dst": [4, 8]},
+        ]}, "blackhole")
+    params = Params.from_text(base_conf(spec) + f"SCENARIO: {spath}\n")
+    rep = get_backend("tpu_hash")(params, seed=3).extra["scenario_report"]
+    inv = rep["invariants"]
+    fr = inv["no_false_removals"]
+    assert fr["count"] > 0, "blackhole never tripped a false removal"
+    assert "heavy_loss" in fr["excused_by"]
+    assert fr["ok"] and inv["removals_healed"]["ok"]
+    assert rep["ok"], rep["violations"]
+
+
+# ---------------------------------------------------------------------------
+# Shrinker: pure, deterministic, minimal
+
+
+def _fake_schedule():
+    return {
+        "name": "fake", "events": [
+            {"kind": "crash", "time": 20, "range": [0, 2]},
+            {"kind": "restart", "time": 40, "range": [0, 2]},
+            {"kind": "delay_window", "start": 10, "stop": 30,
+             "dst": [4, 8]},
+            {"kind": "drop_window", "start": 30, "stop": 90,
+             "drop_prob": 0.7},
+            {"kind": "link_flake", "start": 50, "stop": 60,
+             "src": [0, 8], "dst": [8, 16], "drop_prob": 0.1},
+            {"kind": "leave", "time": 70, "range": [9, 10]},
+        ]}
+
+
+def _fake_predicate(cand):
+    """Violates iff a heavy drop_window covers tick 50 — everything
+    else in the schedule is shrinkable noise."""
+    return any(e["kind"] == "drop_window" and e.get("drop_prob", 0) >= 0.5
+               and e["start"] <= 50 < e["stop"]
+               for e in cand["events"])
+
+
+@pytest.mark.quick
+def test_shrinker_deterministic_minimal():
+    sch = _fake_schedule()
+    frozen = copy.deepcopy(sch)
+    m1, s1 = shrink_schedule(sch, _fake_predicate)
+    m2, s2 = shrink_schedule(sch, _fake_predicate)
+    assert sch == frozen                    # input never mutated
+    assert dump_schedule(m1) == dump_schedule(m2)
+    assert s1 == s2                         # probes/rounds pinned too
+    assert len(m1["events"]) == 1
+    ev = m1["events"][0]
+    assert ev["kind"] == "drop_window"
+    # Window narrowed to the minimal span still covering tick 50.
+    assert ev["start"] <= 50 < ev["stop"]
+    assert ev["stop"] - ev["start"] <= 2
+    assert s1["events_before"] == 6 and s1["events_after"] == 1
+    with pytest.raises(ValueError, match="does not violate"):
+        shrink_schedule({"name": "quiet", "events": []},
+                        _fake_predicate)
+
+
+@pytest.mark.quick
+def test_bank_repro_idempotent_identity(tmp_path):
+    """The banked name is the digest of the EVENTS alone: re-banking is
+    idempotent, and the same minimal repro found from two different
+    fuzzed origins lands on one file."""
+    minimal = {"name": "chaos-0-0007", "events": [
+        {"kind": "drop_window", "start": 49, "stop": 51,
+         "drop_prob": 0.7}]}
+    p1 = bank_repro(dict(minimal), str(tmp_path), {"seed": 7})
+    p2 = bank_repro(dict(minimal, name="other-origin"),
+                    str(tmp_path), {"seed": 9, "campaign": "abc"})
+    assert p1 == p2
+    assert len(list(tmp_path.iterdir())) == 1
+    banked = json.loads(pathlib.Path(p1).read_text())
+    assert banked["name"] == os.path.splitext(os.path.basename(p1))[0]
+    # Banked repros are runnable scenarios as-is.
+    scn = load_scenario(p1)
+    assert [dict(e) for e in scn.events] == minimal["events"]
+
+
+# ---------------------------------------------------------------------------
+# Journal: torn-tolerant append/replay
+
+
+@pytest.mark.quick
+def test_journal_torn_line_tolerated(tmp_path):
+    path = str(tmp_path / "campaign.jsonl")
+    j = Journal(path)
+    j.append({"kind": "campaign", "digest": "d"})
+    j.append({"kind": "graded", "run_id": "r0", "ok": True})
+    j.close()
+    with open(path, "a") as fh:            # crash mid-write: torn tail
+        fh.write('{"kind": "graded", "run_id": "r1", "o')
+    rows = read_journal(path)
+    assert [r["kind"] for r in rows] == ["campaign", "graded"]
+    assert read_journal(str(tmp_path / "missing.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# Campaigns: CI smoke, acceptance sweep, broken-config repro exercise
+
+
+@pytest.mark.quick
+def test_mini_campaign_smoke(tmp_path):
+    """The CI mini-campaign: N=10, 8 seeded schedules in-process, all
+    green — and run_report renders the journal as campaign progress."""
+    spec = CampaignSpec(seed=2, schedules=8, name="mini")
+    out = tmp_path / "camp"
+    summary = run_campaign(spec, str(out))
+    assert summary["ok"], summary
+    assert summary["runs"] == 8 and not summary["violations"]
+    rows = read_journal(str(out / "campaign.jsonl"))
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["campaign"] + ["graded"] * 8 + ["done"]
+    assert rows[0]["digest"] == campaign_digest(spec)
+    assert all(r["ok"] for r in rows[1:-1])
+    assert len(list((out / "scenarios").iterdir())) == 8
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+    assert run_report.is_campaign_root(str(out))
+    rep = run_report.campaign_report(str(out))
+    assert rep["graded"] == 8 and rep["planned"] == 8
+    assert rep["done"] and rep["ok"] and not rep["violations"]
+    md = run_report.render_campaign(rep)
+    assert "graded 8/8" in md and "violations 0" in md
+
+
+@pytest.mark.quick
+def test_campaign_acceptance_64_green(tmp_path):
+    """The acceptance sweep: 64 seeded schedules at N=10, end-to-end,
+    ZERO violations.  Quick-tier affordable because the fuzzer holds
+    ScenarioStatic fixed — the whole campaign pays one compile."""
+    summary = run_campaign(CampaignSpec(seed=0, schedules=64),
+                           str(tmp_path / "camp"))
+    assert summary["ok"], summary["violations"]
+    assert summary["runs"] == 64 and not summary["repros"]
+
+
+@pytest.mark.quick
+def test_broken_config_shrinks_reproducibly(tmp_path):
+    """The negative acceptance exercise: a deliberately broken config
+    (forced 60% global loss, a mix with no maskable events so nothing
+    is excusable) yields violations, and the auto-shrunk repros are
+    REPRODUCIBLE — two independent campaigns bank identical files."""
+    spec = CampaignSpec(seed=4, schedules=2, events=4,
+                        mix={"link_flake": 1.0, "drop_window": 1.0},
+                        name="broken")
+    overrides = {"DROP_MSG": 1, "MSG_DROP_PROB": 0.6}
+    outs = []
+    for tag in ("a", "b"):
+        summary = run_campaign(spec, str(tmp_path / tag),
+                               overrides=overrides)
+        assert not summary["ok"]
+        assert summary["violations"] and summary["repros"]
+        outs.append(sorted(os.path.basename(p)
+                           for p in summary["repros"]))
+        rows = read_journal(str(tmp_path / tag / "campaign.jsonl"))
+        assert rows[0]["overrides"] == {"DROP_MSG": 1,
+                                        "MSG_DROP_PROB": 0.6}
+        shrunk = [r for r in rows if r["kind"] == "shrunk"]
+        assert shrunk and all(r["events"] >= 1 for r in shrunk)
+    assert outs[0] == outs[1]               # same minimal repros, twice
+    a, b = (sorted((tmp_path / t / "regressions").iterdir())
+            for t in ("a", "b"))
+    assert [p.read_bytes() for p in a] == [q.read_bytes() for q in b]
+    # Every banked repro records its provenance and is runnable.
+    meta = json.loads(a[0].read_text())["meta"]
+    assert meta["campaign"] == campaign_digest(spec)
+    assert "shrunk_from" in meta and "violations" in meta
+    load_scenario(str(a[0]))
+
+
+@pytest.mark.quick
+def test_campaign_mode_validation(tmp_path):
+    with pytest.raises(ValueError, match="inproc|fleet"):
+        run_campaign(CampaignSpec(), str(tmp_path), mode="warp")
+    with pytest.raises(ValueError, match="port"):
+        run_campaign(CampaignSpec(), str(tmp_path), mode="fleet")
+
+
+# ---------------------------------------------------------------------------
+# fleet_submit hardening: 502 retry with backoff, scenario-dir fan-out
+
+
+def _stub_http(monkeypatch, statuses):
+    """Replace http.client.HTTPConnection with a scripted stub; returns
+    the call log.  Sleeps are recorded, not slept."""
+    log = {"attempts": 0, "sleeps": [], "bodies": []}
+
+    class _Resp:
+        def __init__(self, status):
+            self.status = status
+
+        def read(self):
+            return b'{"run_id": "x", "state": "queued", "mode": "m"}'
+
+    class _Conn:
+        def __init__(self, host, port, timeout=None):
+            pass
+
+        def request(self, method, path, body=None, headers=None):
+            log["bodies"].append(body)
+
+        def getresponse(self):
+            i = min(log["attempts"], len(statuses) - 1)
+            log["attempts"] += 1
+            return _Resp(statuses[i])
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(fleet_submit.http.client, "HTTPConnection",
+                        _Conn)
+    monkeypatch.setattr(fleet_submit.time, "sleep",
+                        lambda s: log["sleeps"].append(s))
+    return log
+
+
+@pytest.mark.quick
+def test_fleet_submit_retries_transient_502(monkeypatch):
+    log = _stub_http(monkeypatch, [502, 502, 202])
+    status, obj = fleet_submit._req(1, "POST", "/v1/runs",
+                                    body={"run_id": "x"}, retries=5)
+    assert status == 202 and obj["state"] == "queued"
+    assert log["attempts"] == 3
+    assert log["sleeps"] == [0.25, 0.5]     # exponential backoff
+
+    log = _stub_http(monkeypatch, [502])
+    status, _ = fleet_submit._req(1, "GET", "/v1/runs")   # retries=0
+    assert status == 502 and log["attempts"] == 1
+
+    log = _stub_http(monkeypatch, [500, 202])   # 500 is NOT transient
+    status, _ = fleet_submit._req(1, "GET", "/v1/runs", retries=5)
+    assert status == 500 and log["attempts"] == 1
+
+    log = _stub_http(monkeypatch, [502, 502, 202])
+    acks = fleet_submit.submit_grid(1, [{"conf": "c", "run_id": "x"}])
+    assert len(acks) == 1 and log["attempts"] == 3
+
+
+@pytest.mark.quick
+def test_fleet_submit_scenario_dir_subs(tmp_path):
+    spec = CampaignSpec(schedules=2, name="dirfan")
+    for i in range(2):
+        _write_schedule(tmp_path, fuzz_schedule(spec, i))
+    subs = fleet_submit.scenario_dir_subs(
+        [{"conf": "X: 1\n", "run_id": "cell", "seed": 3}],
+        str(tmp_path))
+    assert len(subs) == 2
+    assert [s["run_id"] for s in subs] == [
+        "cell-dirfan-0-0000", "cell-dirfan-0-0001"]
+    for s in subs:
+        assert s["scenario"]["events"]      # shipped inline
+        assert s["seed"] == 3
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no .*json"):
+        fleet_submit.scenario_dir_subs(
+            [{"conf": "X: 1\n", "run_id": "cell"}], str(empty))
+
+
+# ---------------------------------------------------------------------------
+# Slow arms: fleet fan-out, multi-process kill/resume, replica staleness
+
+
+@pytest.mark.slow
+def test_fleet_backed_campaign(tmp_path):
+    """A real campaign against a real subprocess fleet controller:
+    schedules ship inline, workers grade themselves via the oracle
+    report in each run dir, and the campaign summary is green."""
+    import test_fleet as tf
+    spec = CampaignSpec(seed=6, schedules=3, events=4, total=120,
+                        name="fleetcamp")
+    root = str(tmp_path)
+    proc, port = tf._start_fleet(root, max_concurrency=2)
+    try:
+        out = tmp_path / "camp"
+        summary = run_campaign(spec, str(out), mode="fleet",
+                               port=port, fleet_root=root)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+    assert summary["ok"], summary
+    assert summary["runs"] == 3
+    rows = read_journal(str(out / "campaign.jsonl"))
+    graded = [r for r in rows if r["kind"] == "graded"]
+    assert len(graded) == 3 and all(r["ok"] for r in graded)
+    for r in graded:
+        rep = json.load(open(os.path.join(root, r["run_id"],
+                                          "scenario.json")))
+        assert rep["ok"] and not rep["violations"]
+
+
+_MP_CHAOS_CONF = (
+    "MAX_NNB: 64\nSINGLE_FAILURE: 0\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 4\nFANOUT: 3\nTFAIL: 8\n"
+    "TREMOVE: 16\nTOTAL_TIME: 80\nJOIN_MODE: warm\nEVENT_MODE: agg\n"
+    "EXCHANGE: ring\nEXCHANGE_MODE: batched\n"
+    "BACKEND: tpu_hash_sharded\n")
+
+
+@pytest.mark.slow
+def test_multiproc_campaign_kill_resume(tmp_path):
+    """A fuzzed campaign schedule rides ``--scenario`` through the
+    2-process launcher; both processes are killed at a checkpoint
+    boundary INSIDE an active delay window and the --resume rerun is
+    byte-identical to an uninterrupted reference — chaos campaigns
+    survive the pod runtime's crash/resume path."""
+    import test_exchange as tx
+    spec = CampaignSpec(seed=5, schedules=1, n=64, total=80, tfail=8,
+                        tremove=16, events=3,
+                        mix={"crash": 1.0, "restart": 1.0,
+                             "delay_window": 1.0}, name="mp")
+    # Deterministic search: first index whose delay window straddles
+    # the tick-20 boundary (checkpoint-every 20, crash injected at 10).
+    sch = next(
+        s for s in (fuzz_schedule(spec, i) for i in range(200))
+        if any(e["kind"] == "delay_window" and e["start"] <= 14
+               and e["stop"] >= 26 for e in s["events"]))
+    spath = _write_schedule(tmp_path, sch)
+    conf = tmp_path / "mp.conf"
+    conf.write_text(_MP_CHAOS_CONF)
+    base = ("--procs", "2", "--checkpoint-every", "20")
+    # "--" ends the launcher's own options; the rest is forwarded
+    # verbatim to every per-process CLI invocation.
+    tail = ("--", "--scenario", spath)
+
+    ref = tx._launch(conf, tmp_path / "ref", *base, *tail)
+    assert ref.returncode == 0, (ref.stdout, ref.stderr)
+
+    crashed = tx._launch(conf, tmp_path / "kr", *base, *tail,
+                         env_extra={ck.CRASH_ENV: "10"})
+    assert crashed.returncode != 0
+
+    resumed = tx._launch(conf, tmp_path / "kr", *base, "--resume",
+                         *tail)
+    assert resumed.returncode == 0, (resumed.stdout, resumed.stderr)
+    for name in ("dbg.log", "stats.log"):
+        assert tx._read(tmp_path / "kr", 0, name) == tx._read(
+            tmp_path / "ref", 0, name), name
+        assert tx._read(tmp_path / "kr", 1, name) == tx._read(
+            tmp_path / "ref", 1, name), name
+
+
+@pytest.mark.slow
+def test_replica_staleness_under_churn(tmp_path, monkeypatch):
+    """Delta-replica staleness under a fuzzed churn schedule: the
+    engine publishes incremental snapshot deltas across crash/restart
+    churn, and a shm read replica's replies stay byte-equal to the
+    engine's at completion — and the run itself grades green."""
+    import test_query_tier as qt
+    from distributed_membership_tpu.service.daemon import serve_run
+
+    derive_threads, published = qt._spy_derives(monkeypatch)
+    spec = CampaignSpec(seed=9, n=16, total=120, tfail=8, tremove=20,
+                        events=4, mix={"crash": 1.5, "restart": 1.5,
+                                       "leave": 1.0}, name="churn")
+    sch = fuzz_schedule(spec, 1)
+    assert any(e["kind"] == "restart" for e in sch["events"])
+    spath = _write_schedule(tmp_path, sch)
+    p = Params.from_text(
+        base_conf(spec)
+        + "EVENT_MODE: full\nCHECKPOINT_EVERY: 30\n"
+          "SERVICE_PORT: 0\nSERVICE_WORKERS: 1\n"
+          "SERVICE_SHM_BUFFERS: 4\n"
+        + f"SCENARIO: {spath}\n"
+        + f"CHECKPOINT_DIR: {tmp_path / 'ck'}\n"
+        + f"TELEMETRY_DIR: {tmp_path / 'tl'}\n")
+    out = tmp_path / "churn"
+    out.mkdir()
+
+    def script(port):
+        h = qt._wait_health(port, lambda h: h["status"] == "complete")
+        assert h["replicas"], h
+        rport = h["replicas"][0]["port"]
+        deadline_tick = h["snapshot_tick"]
+        qt._wait_health(rport,
+                        lambda rh: rh["snapshot_tick"] == deadline_tick
+                        and rh["status"] == "complete")
+        for path in ("/v1/census", "/v1/member/0", "/v1/member/9"):
+            assert (qt._raw(port, "GET", path)
+                    == qt._raw(rport, "GET", path)), path
+        return h
+
+    rc, h = qt._served(lambda: serve_run(p, seed=7, out_dir=str(out)),
+                       str(out), script)
+    assert rc == 0
+    # Churn went through the DELTA path, not full re-derives.
+    modes = [s.derive_info["mode"] for s in published]
+    assert "delta" in modes, modes
+    rep = json.load(open(tmp_path / "tl" / "scenario.json"))
+    assert rep["ok"], rep["violations"]
+    assert rep["invariants"]["restarts_rejoined"]["ok"]

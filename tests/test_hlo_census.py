@@ -129,7 +129,7 @@ def test_scenario_census_bounded_at_1m_s16():
     plain = hlo_census.step_census(hlo_census.census_params(1 << 20, 16))
     assert base == plain
 
-    for arm in ("partition", "chaos"):
+    for arm in ("partition", "chaos", "gray"):
         c = out[arm]
         assert c["big_gathers"] == base["big_gathers"], (arm, c)
         assert c["big_gather_shapes"] == base["big_gather_shapes"]
@@ -147,6 +147,15 @@ def test_scenario_census_bounded_at_1m_s16():
     assert out["chaos"]["threefry_calls"] <= drops["threefry_calls"]
     assert 0 <= (out["chaos"]["ns_class_ops"]
                  - base["ns_class_ops"]) <= 64
+    # Widened gray-failure vocabulary (one_way_flake + delay_window):
+    # one_way rides the existing flake rows (no new RNG class — still
+    # within the drop-class threefry budget) and the delay gate is pure
+    # elementwise masking over small [D] tensors.
+    assert out["gray"]["threefry_calls"] <= drops["threefry_calls"]
+    assert out["gray"]["threefry_calls"] \
+        == out["chaos"]["threefry_calls"]
+    assert 0 <= (out["gray"]["ns_class_ops"]
+                 - base["ns_class_ops"]) <= 96
 
 
 @pytest.mark.quick

@@ -87,7 +87,9 @@ def test_schema_validation_rejects_malformed():
 
 @pytest.mark.quick
 def test_shipped_scenarios_parse():
-    for p in sorted(SCNDIR.glob("*.json")):
+    # rglob: also covers banked chaos repros (scenarios/regressions/),
+    # whose node ranges fit any n at least their campaign's.
+    for p in sorted(SCNDIR.rglob("*.json")):
         scn = load_scenario(str(p))
         assert scn.events, p
         validate_scenario(scn, n=2048, total=700)
@@ -298,6 +300,13 @@ _RESUME_EVENTS = [
      "groups": [[0, 16], [16, 32]]},
     {"kind": "crash", "time": 60, "range": [4, 6]},
     {"kind": "restart", "time": 420, "range": [4, 6]},
+    # The mid-run kill (tick 150) lands INSIDE this window: held
+    # inbound mail (the max-merged mailboxes) must survive the
+    # checkpoint carry and drain identically after resume.
+    {"kind": "delay_window", "start": 130, "stop": 180,
+     "dst": [20, 28]},
+    {"kind": "one_way_flake", "start": 390, "stop": 405,
+     "src": [16, 32], "dst": [0, 4]},
 ]
 
 
@@ -382,7 +391,12 @@ def _sharded_partition_runs(tmp_path, n, tag, total=160, start=40,
                             stop=96, seed=7):
     spath = _scn_file(tmp_path, [
         {"kind": "partition", "start": start, "stop": stop,
-         "groups": [[0, n // 2], [n // 2, n]]}], tag)
+         "groups": [[0, n // 2], [n // 2, n]]},
+        # Delay window straddling the mid-partition kill tick: the
+        # sharded ring step's recv-mask gate (and its folded twin's
+        # act_base split) must stay bit-exact across resume.
+        {"kind": "delay_window", "start": (start + stop) // 2 - 8,
+         "stop": (start + stop) // 2 + 12, "dst": [0, n // 8]}], tag)
     base = (f"MAX_NNB: {n}\nSINGLE_FAILURE: 0\nDROP_MSG: 0\n"
             "MSG_DROP_PROB: 0\nVIEW_SIZE: 16\nGOSSIP_LEN: 8\n"
             "PROBES: 4\nFANOUT: 3\nTFAIL: 8\nTREMOVE: 20\n"
