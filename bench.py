@@ -49,7 +49,10 @@ BENCH_EXCHANGE=1 re-times the leg on the SHARDED backend with the
 batched fanout exchange on vs off (EXCHANGE_MODE — ops/exchange: the
 whole gossip fanout as one all_to_all per tick), interleaved; banked as
 bench:live:hash:exchange (keyed rung:p{P} under a DM_DIST_* multi-
-process run).
+process run), BENCH_METRICS=1 re-times the SERVED leg under query load
+with vs. without a paced /metrics scraper process (BENCH_METRICS_HZ,
+default 10/s; best-of-BENCH_METRICS_REPS, default 5), interleaved;
+banked as bench:live:hash:metrics (observability/metricsbus.py).
 
 Every live leg row is also banked into ``artifacts/perf_ledger.jsonl``
 (observability/perfdb.py) and checked against history; a regression
@@ -542,6 +545,210 @@ def _bench_service(base_text: str, n: int, ticks: int) -> dict:
         if best.get("derive"):
             out["service_derive_mode"] = best["derive"].get("mode")
             out["service_derive_ms"] = best["derive"].get("ms")
+    return out
+
+
+def _metrics_scraper_main(port: int, hz: float) -> int:
+    """Hidden child mode (``--metrics-scraper``) for _bench_metrics.
+
+    Scrapes ``GET /metrics`` at a paced cadence from a SEPARATE
+    process — a real Prometheus scraper does not share the engine's
+    interpreter, so its HTTP parsing must not be billed to the tick
+    loop's GIL — until stdin says stop; prints one JSON stats line."""
+    import http.client as _hc
+    import threading
+
+    stop = threading.Event()
+
+    def _waiter():
+        sys.stdin.readline()
+        stop.set()
+
+    threading.Thread(target=_waiter, daemon=True).start()
+    conn = _hc.HTTPConnection("127.0.0.1", port, timeout=30)
+    period = 1.0 / max(hz, 1e-9)
+    scrapes, nbytes, lat_ms = 0, 0, []
+    t_start = time.time()
+    while not stop.is_set():
+        t0 = time.perf_counter()
+        try:
+            conn.request("GET", "/metrics")
+            r = conn.getresponse()
+            body = r.read()
+            if r.status == 200:
+                scrapes += 1
+                nbytes = len(body)
+                lat_ms.append(1000 * (time.perf_counter() - t0))
+        except Exception:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = _hc.HTTPConnection("127.0.0.1", port, timeout=30)
+        stop.wait(max(0.0, period - (time.perf_counter() - t0)))
+    lat = sorted(lat_ms)
+    print(json.dumps({
+        "scrapes": scrapes, "seconds": round(time.time() - t_start, 3),
+        "payload_bytes": nbytes,
+        "scrape_p50_ms": round(lat[len(lat) // 2], 3) if lat else None,
+        "scrape_max_ms": round(lat[-1], 3) if lat else None}))
+    return 0
+
+
+def _bench_metrics(base_text: str, n: int, ticks: int) -> dict:
+    """BENCH_METRICS=1: price the live /metrics scrape path under load.
+
+    Two SERVED arms of the identical compiled program, both under the
+    same subprocess query load (:func:`_service_client_main`): the base
+    arm never scrapes; the scrape arm adds a separate paced scraper
+    process hammering ``GET /metrics`` at BENCH_METRICS_HZ (default
+    10/s — an aggressive cadence; Prometheus defaults to one scrape per
+    15–60 s).  The delta isolates what live metrics export costs the
+    tick loop: the registry instrument updates on the hot query path
+    plus the text render + HTTP serve per scrape.  Interleaved
+    best-of-R (BENCH_METRICS_REPS, default 5) as the other comparison
+    legs.  ISSUE bound at 65k_s16 on CPU: <= 3% overhead vs the
+    no-scrape served arm."""
+    import http.client as _hc
+    import shutil
+    import tempfile
+    import threading
+
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.service import daemon as _daemon
+
+    hz = float(os.environ.get("BENCH_METRICS_HZ", "10"))
+    reps = int(os.environ.get("BENCH_METRICS_REPS", "5"))
+    every = int(os.environ.get("BENCH_SERVICE_EVERY",
+                               str(max(ticks // 8, 1))))
+    sstats = []         # one scraper {"scrapes", "seconds", ...} per rep
+
+    tmp = tempfile.mkdtemp(prefix="bench_metrics_")
+    plain_out = os.path.join(tmp, "plain")
+    scrape_out = os.path.join(tmp, "scrape")
+    p_plain = Params.from_text(
+        base_text + f"CHECKPOINT_EVERY: {every}\n"
+        f"CHECKPOINT_DIR: {os.path.join(plain_out, 'ck')}\n"
+        "SERVICE_PORT: 0\n")
+    p_scrape = Params.from_text(
+        base_text + f"CHECKPOINT_EVERY: {every}\n"
+        f"CHECKPOINT_DIR: {os.path.join(scrape_out, 'ck')}\n"
+        "SERVICE_PORT: 0\n")
+
+    def _health(mon):
+        mon.request("GET", "/healthz")
+        return json.loads(mon.getresponse().read())
+
+    def _drive(out_dir, rec, scrape):
+        """Client side of one served rep: wait for the port and the
+        first snapshot, start the query load (both arms) and — on the
+        scrape arm only — the paced scraper process, run both until
+        the engine completes, then release the post-run serve loop."""
+        sj = os.path.join(out_dir, _daemon.SERVICE_JSON)
+        port = None
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            try:
+                with open(sj) as fh:
+                    port = json.load(fh)["port"]
+                break
+            except (OSError, ValueError, KeyError):
+                time.sleep(0.02)
+        if port is None:
+            rec["error"] = "service.json never appeared"
+            return
+        mon = _hc.HTTPConnection("127.0.0.1", port, timeout=30)
+        while True:
+            h = _health(mon)
+            if (h.get("snapshot_tick") is not None
+                    or h["status"] in ("complete", "interrupted")):
+                break
+            time.sleep(0.01)
+        load = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--service-client", str(port), "--n", str(n)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        scraper = None
+        if scrape:
+            scraper = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--metrics-scraper", str(port)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True,
+                env={**os.environ, "BENCH_METRICS_HZ": str(hz)})
+        try:
+            while _health(mon)["status"] not in ("complete",
+                                                 "interrupted"):
+                time.sleep(0.01)
+        finally:
+            for proc, sink in ((load, None), (scraper, sstats)):
+                if proc is None:
+                    continue
+                try:
+                    out, _ = proc.communicate(input="stop\n",
+                                              timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    out = ""
+                if sink is None:
+                    continue
+                for line in reversed((out or "").strip().splitlines()):
+                    try:
+                        sink.append(json.loads(line))
+                        break
+                    except json.JSONDecodeError:
+                        continue
+        try:
+            mon.request("POST", "/v1/admin/shutdown", body=b"")
+            mon.getresponse().read()
+        except Exception:
+            pass
+        mon.close()
+
+    def _svc_scan(params, plan, seed=0, collect_events=False,
+                  total_time=None):
+        """run_scan-shaped dispatch (the _bench_service pattern) so
+        _interleaved_best can interleave the two served arms; the
+        scrape arm is told apart by params identity."""
+        scrape = params is p_scrape
+        out = scrape_out if scrape else plain_out
+        os.makedirs(out, exist_ok=True)
+        sj = os.path.join(out, _daemon.SERVICE_JSON)
+        if os.path.exists(sj):
+            os.unlink(sj)           # a client must never poll a dead port
+        rec = {}
+        th = threading.Thread(target=_drive, args=(out, rec, scrape),
+                              daemon=True)
+        th.start()
+        _daemon.serve_run(params, seed=seed, out_dir=out)
+        th.join(timeout=60)
+        return None, None
+
+    try:
+        base_wall, _ = _timed_runs(_svc_scan, p_plain, None, ticks)
+        walls = _interleaved_best(_svc_scan, ticks, (p_plain, None),
+                                  {"scrape": (p_scrape, None)}, reps,
+                                  base_wall)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {
+        "metrics_hz": hz,
+        "metrics_reps": reps,
+        "metrics_base_wall_seconds": round(walls["base"], 3),
+        "metrics_wall_seconds": round(walls["scrape"], 3),
+        "metrics_overhead_pct": round(
+            100 * (walls["scrape"] - walls["base"])
+            / max(walls["base"], 1e-9), 1),
+    }
+    best = max(sstats, key=lambda r: r.get("scrapes", 0), default=None)
+    if best:
+        out["metrics_scrapes"] = best["scrapes"]
+        if best.get("seconds"):
+            out["metrics_scrapes_per_sec"] = round(
+                best["scrapes"] / best["seconds"], 2)
+        for k in ("payload_bytes", "scrape_p50_ms", "scrape_max_ms"):
+            if best.get(k) is not None:
+                out[f"metrics_{k}"] = best[k]
     return out
 
 
@@ -1243,6 +1450,16 @@ def leg_hash(n: int, ticks: int, pin: str | None,
                           "FOLDED: 0\n"
                         + tail_text)
             ckpt_fields.update(_bench_service(svc_text, n, ticks))
+    # BENCH_METRICS=1: price the live /metrics scrape path — the served
+    # run under the same client query load, with vs. without a paced
+    # scraper process (_bench_metrics).  Same kernel pinning rationale
+    # as the service leg: both arms run the program a served run
+    # actually ships.
+    if os.environ.get("BENCH_METRICS", "0") not in ("", "0"):
+        met_text = (geom_text
+                    + "FUSED_RECEIVE: 0\nFUSED_GOSSIP: 0\nFOLDED: 0\n"
+                    + tail_text)
+        ckpt_fields.update(_bench_metrics(met_text, n, ticks))
     if os.environ.get("BENCH_RNG", "0") not in ("", "0"):
         ckpt_fields.update(_bench_rng_micro(
             make_config(params, collect_events=False)))
@@ -1473,6 +1690,25 @@ def _ledger_bank(leg: str, row: dict) -> None:
                         f"bench:live:{leg}:service", metric=metric,
                         value=row[field], higher_is_better=False,
                         **svc_common))
+        if row.get("metrics_wall_seconds"):
+            # The BENCH_METRICS companion row: what live /metrics
+            # scraping costs the served tick loop (lower is better),
+            # keyed apart so perfdb tracks the scrape path's own trend
+            # against the ISSUE's <= 3% bound.
+            rows.append(perfdb.make_row(
+                f"bench:live:{leg}:metrics",
+                metric="metrics_overhead_pct",
+                value=row["metrics_overhead_pct"],
+                higher_is_better=False,
+                n=row.get("n"), s=row.get("view_size"),
+                backend="tpu_hash" if leg == "hash" else "dense",
+                platform=row.get("platform"),
+                knobs={"hz": row.get("metrics_hz"),
+                       "base_wall_seconds":
+                       row.get("metrics_base_wall_seconds"),
+                       "wall_seconds": row.get("metrics_wall_seconds"),
+                       "ticks": row.get("ticks")},
+                source="bench.py"))
         if row.get("fprobe_wall_seconds"):
             # The BENCH_FPROBE companion row: fused-vs-unfused probe
             # traversal delta (positive = the Pallas kernel wins), keyed
@@ -1596,6 +1832,8 @@ def main() -> int:
     ap.add_argument("--pin-cpu", action="store_true")
     ap.add_argument("--service-client", type=int, default=None,
                     metavar="PORT", help=argparse.SUPPRESS)
+    ap.add_argument("--metrics-scraper", type=int, default=None,
+                    metavar="PORT", help=argparse.SUPPRESS)
     ap.add_argument("--connect", default="",
                     metavar="HOST:PORT", help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -1603,6 +1841,11 @@ def main() -> int:
     if args.service_client is not None:   # _bench_service's query load
         return _service_client_main(args.service_client, args.n,
                                     connect=args.connect)
+
+    if args.metrics_scraper is not None:  # _bench_metrics's scrape load
+        return _metrics_scraper_main(
+            args.metrics_scraper,
+            float(os.environ.get("BENCH_METRICS_HZ", "10")))
 
     if args.leg:   # child mode
         pin = "cpu" if args.pin_cpu else None

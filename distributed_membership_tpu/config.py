@@ -382,6 +382,15 @@ class Params:
     # deterministically); 0 = shut workers down on completion so the
     # process table holds only ticking runs.
     FLEET_LINGER: int = 0
+    # Mid-run SLO watchdog (observability/watchdog.py), served runs
+    # only: a daemon thread evaluates degradation rules (tick-rate
+    # collapse, publisher backlog growth, replica staleness, live
+    # detection-latency SLO) at segment boundaries, off the engine
+    # thread, emitting structured alert records into runlog.jsonl.
+    # Trajectory-inert and identity-excluded like the SERVICE_* keys
+    # (host-side observation only); 0 turns it off for overhead
+    # benches.
+    WATCHDOG: int = 1
 
     def getcurrtime(self) -> int:
         """Time since start of run, in ticks (Params.cpp:48-50)."""
@@ -606,6 +615,9 @@ class Params:
         if self.FLEET_LINGER not in (0, 1):
             raise ValueError(
                 f"FLEET_LINGER must be 0 or 1, got {self.FLEET_LINGER!r}")
+        if self.WATCHDOG not in (0, 1):
+            raise ValueError(
+                f"WATCHDOG must be 0 or 1, got {self.WATCHDOG!r}")
         for knob in ("FUSED_RECEIVE", "FUSED_GOSSIP", "FUSED_PROBE",
                      "FOLDED"):
             if getattr(self, knob) not in (-1, 0, 1):

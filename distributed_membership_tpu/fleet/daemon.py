@@ -3,7 +3,10 @@
 The controller serves two kinds of routes from one stdlib server:
 
   * fleet routes it owns — submit/list/inspect runs, pause/resume/
-    kill, ``/v1/fleet/summary``, ``/healthz``, admin shutdown;
+    kill, ``/v1/fleet/summary``, ``/healthz``, ``/metrics`` (the
+    fleet-wide Prometheus union: controller gauges + every running
+    worker's scrape relabeled with ``run_id`` + replica-beacon
+    gauges), admin shutdown;
   * the ENTIRE single-run surface under ``/v1/runs/<id>/...`` — not
     re-implemented but forwarded verbatim to the run's worker daemon,
     whose handlers are the shared ``service/api.py`` route functions.
@@ -22,8 +25,10 @@ existing chunked driver).
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import signal
 import sys
 import threading
@@ -34,11 +39,53 @@ from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.fleet.registry import Registry
 from distributed_membership_tpu.fleet.scheduler import (
     Scheduler, reap_orphans, sweep_stale_rings)
+from distributed_membership_tpu.observability import metricsbus
+from distributed_membership_tpu.observability.beacon import (
+    read_beacon, write_beacon)
+from distributed_membership_tpu.observability.runlog import read_events
 from distributed_membership_tpu.service import api
 
 FLEET_JSON = "fleet.json"
 _RUNS_PREFIX = "/v1/runs"
 _VERBS = ("pause", "resume", "kill")
+# A worker scrape must never stall the fleet's own /metrics reply
+# behind a wedged daemon: connection-level failures simply drop that
+# worker's samples from this scrape.
+_SCRAPE_TIMEOUT_S = 1.0
+_BEACON_FRESH_S = 10.0
+
+
+def _alert_counts(run_dir: str) -> dict:
+    """Per-rule watchdog alert counts from a run's runlog; {} when the
+    run has no runlog (headless, telemetry off) or it is unreadable."""
+    counts: dict = {}
+    try:
+        events = read_events(os.path.join(run_dir, "runlog.jsonl"),
+                             kinds=("alert",))
+    except OSError:
+        return counts
+    for ev in events:
+        rule = ev.get("rule", "?")
+        counts[rule] = counts.get(rule, 0) + 1
+    return counts
+
+
+def _scrape(port: int, timeout: float = _SCRAPE_TIMEOUT_S) -> str:
+    """One GET /metrics round-trip to a worker; '' on any failure."""
+    import http.client
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return ""
+            return resp.read().decode("utf-8", errors="replace")
+        finally:
+            conn.close()
+    except OSError:
+        return ""
 
 
 class FleetState:
@@ -56,6 +103,18 @@ class FleetState:
         self.port: Optional[int] = None
         self.queries = 0
         self.rr = 0             # replica round-robin cursor (proxy)
+        m = self.metrics = metricsbus.MetricsRegistry()
+        self._m_runs = m.gauge(
+            "dm_fleet_runs", "Runs by registry state")
+        self._m_workers = m.gauge(
+            "dm_fleet_workers_alive", "Live (non-lingering) workers")
+        self._m_queries = m.counter(
+            "dm_fleet_queries_total", "Fleet-surface requests served")
+        self._m_uptime = m.gauge(
+            "dm_fleet_uptime_seconds", "Controller uptime")
+        self._m_alerts = m.gauge(
+            "dm_fleet_watchdog_alerts",
+            "Watchdog alerts journaled per run and rule")
 
     # -- fleet routes (each returns (code, json-able)) -----------------
     def health(self) -> dict:
@@ -176,8 +235,9 @@ class FleetState:
             ticks_total += rec.tick
             row = {"run_id": rec.run_id, "state": rec.state,
                    "tick": rec.tick, "total": rec.total,
-                   "live": None, "slo": None}
+                   "live": None, "slo": None, "alerts": {}}
             run_dir = rec.run_dir(root)
+            row["alerts"] = _alert_counts(run_dir)
             tl = os.path.join(run_dir, "timeline.jsonl")
             if os.path.exists(tl):
                 tail = api._timeline_rows(tl, 0)
@@ -193,10 +253,96 @@ class FleetState:
             except (OSError, ValueError):
                 pass
             rows.append(row)
+        alerts_total = sum(sum(r["alerts"].values()) for r in rows)
         return 200, {"runs": rows,
                      "aggregate": {"runs": len(rows), "states": states,
                                    "live_total": live_total,
-                                   "ticks_total": ticks_total}}
+                                   "ticks_total": ticks_total,
+                                   "alerts_total": alerts_total}}
+
+    def metrics_text(self) -> str:
+        """The fleet-wide metrics union, Prometheus text.
+
+        Three layers, one exposition: the controller's own gauges;
+        every running serve worker's live ``/metrics`` relabeled with
+        its ``run_id`` (the worker already carries ``proc`` when it is
+        a distributed rank); and gauges synthesized from replica
+        beacons via the shared torn-tolerant reader — a replica's
+        freshness story is its beacon, so a wedged replica simply ages
+        out of the union instead of stalling the scrape.  Runs on a
+        handler thread; no engine thread is ever involved.
+        """
+        with self.lock:
+            self.queries += 1
+            q = self.queries
+            states: dict = {}
+            for rec in self.registry.runs.values():
+                states[rec.state] = states.get(rec.state, 0) + 1
+            root = self.registry.root
+            run_dirs = [(rec.run_id, rec.run_dir(root))
+                        for rec in self.registry.runs.values()]
+            targets = []
+            for run_id in list(self.scheduler.workers):
+                port = self.scheduler.worker_port(run_id)
+                if port is not None:
+                    targets.append(
+                        (run_id, port,
+                         self.scheduler.workers[run_id].run_dir))
+            alive = self.scheduler.running_count()
+        self._m_runs.clear()
+        for st in sorted(states):
+            self._m_runs.set(states[st], state=st)
+        self._m_workers.set(alive)
+        self._m_queries.set_total(q)
+        self._m_uptime.set(round(time.time() - self.started_at, 3))
+        self._m_alerts.clear()
+        for run_id, run_dir in run_dirs:
+            counts = _alert_counts(run_dir)
+            for rule in sorted(counts):
+                self._m_alerts.set(counts[rule], run_id=run_id,
+                                   rule=rule)
+        parts = [self.metrics.render()]
+        for run_id, port, _ in targets:
+            text = _scrape(port)
+            if text:
+                parts.append(metricsbus.relabel(text,
+                                                {"run_id": run_id}))
+        rep = metricsbus.MetricsRegistry()
+        r_q = rep.counter("dm_queries_total",
+                          "Replica queries served (from its beacon)")
+        r_qps = rep.gauge("dm_queries_per_sec",
+                          "Replica query rate (from its beacon)")
+        r_snap = rep.gauge("dm_snapshot_tick",
+                           "Replica's freshest served snapshot tick")
+        r_eng = rep.gauge("dm_engine_tick",
+                          "Engine tick as the replica sees it")
+        r_lag = rep.gauge("dm_snapshot_lag_ticks",
+                          "Replica staleness behind its engine")
+        synthesized = False
+        for run_id, _, run_dir in targets:
+            for path in sorted(glob.glob(
+                    os.path.join(run_dir, "replica_*.json"))):
+                m = re.fullmatch(r"replica_(\d+)\.json",
+                                 os.path.basename(path))
+                if m is None:
+                    continue
+                doc = read_beacon(path, max_age_s=_BEACON_FRESH_S,
+                                  require_pid="pid")
+                if doc is None:
+                    continue
+                synthesized = True
+                labels = {"run_id": run_id, "replica": m.group(1)}
+                r_q.set_total(int(doc.get("queries") or 0), **labels)
+                r_qps.set(float(doc.get("qps") or 0.0), **labels)
+                if doc.get("snapshot_tick") is not None:
+                    r_snap.set(int(doc["snapshot_tick"]), **labels)
+                if doc.get("engine_tick") is not None:
+                    r_eng.set(int(doc["engine_tick"]), **labels)
+                if doc.get("tick_lag") is not None:
+                    r_lag.set(int(doc["tick_lag"]), **labels)
+        if synthesized:
+            parts.append(rep.render())
+        return "".join(parts)
 
     def request_shutdown(self) -> None:
         self.stop_event.set()
@@ -336,6 +482,10 @@ def route_get(h: api.ApiHandler, state: FleetState, upath: str,
               query: str) -> None:
     if upath == "/healthz":
         h._json(200, state.health())
+    elif upath == "/metrics":
+        text = state.metrics_text()
+        h._body(200, text.encode(),
+                ctype="text/plain; version=0.0.4; charset=utf-8")
     elif upath == "/v1/fleet/summary":
         code, obj = state.summary()
         h._json(code, obj)
@@ -405,16 +555,12 @@ def port_in_use_hint(err, root: str) -> str:
     its discovery file says so (same UX as service/daemon.py)."""
     lines = [f"fleet: cannot bind — {err.strerror}; pick another "
              "--port (or 0 for ephemeral), or stop the owner"]
-    try:
-        with open(os.path.join(root, FLEET_JSON)) as fh:
-            info = json.load(fh)
-        if info.get("port") == err.port:
-            lines.append(
-                f"fleet: {FLEET_JSON} in {root!r} records pid "
-                f"{info.get('pid')} running a fleet on port "
-                f"{err.port} — that controller likely still owns it")
-    except (OSError, ValueError):
-        pass
+    info = read_beacon(os.path.join(root, FLEET_JSON))
+    if info is not None and info.get("port") == err.port:
+        lines.append(
+            f"fleet: {FLEET_JSON} in {root!r} records pid "
+            f"{info.get('pid')} running a fleet on port "
+            f"{err.port} — that controller likely still owns it")
     return "\n".join(lines)
 
 
@@ -450,11 +596,11 @@ def fleet_main(root: str, port: int = 0, max_concurrency: int = 2,
     state.port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="fleet-api").start()
-    with open(os.path.join(root, FLEET_JSON), "w") as fh:
-        json.dump({"port": state.port, "pid": os.getpid(),
-                   "root": os.path.abspath(root),
-                   "max_concurrency": int(max_concurrency),
-                   "linger": int(linger)}, fh, indent=1)
+    write_beacon(os.path.join(root, FLEET_JSON),
+                 {"port": state.port, "pid": os.getpid(),
+                  "root": os.path.abspath(root),
+                  "max_concurrency": int(max_concurrency),
+                  "linger": int(linger)})
     print(f"fleet: listening on 127.0.0.1:{state.port} "
           f"(pid {os.getpid()}, max {max_concurrency} workers"
           + (", linger" if linger else "") + ")", flush=True)

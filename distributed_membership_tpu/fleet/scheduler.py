@@ -39,6 +39,7 @@ from typing import Dict, Optional
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.fleet.registry import (
     DEFAULT_CHECKPOINT_EVERY, Registry, RunRecord)
+from distributed_membership_tpu.observability.beacon import read_beacon
 from distributed_membership_tpu.runtime.checkpoint import (
     STATE_FILE_ENV, read_run_state)
 from distributed_membership_tpu.service.daemon import SERVICE_JSON
@@ -171,12 +172,8 @@ class _Worker:
         run dir must not be trusted)."""
         if self.port is not None:
             return self.port
-        try:
-            with open(os.path.join(self.run_dir, SERVICE_JSON)) as fh:
-                info = json.load(fh)
-        except (OSError, ValueError):
-            return None
-        if info.get("pid") == self.proc.pid:
+        info = read_beacon(os.path.join(self.run_dir, SERVICE_JSON))
+        if info is not None and info.get("pid") == self.proc.pid:
             self.port = int(info["port"])
         return self.port
 
@@ -184,12 +181,8 @@ class _Worker:
         """Ports of the worker's read-replica pool (service.json
         ``replicas``, pid-checked like :meth:`discover_port`); [] when
         the worker runs without a query tier."""
-        try:
-            with open(os.path.join(self.run_dir, SERVICE_JSON)) as fh:
-                info = json.load(fh)
-        except (OSError, ValueError):
-            return []
-        if info.get("pid") != self.proc.pid:
+        info = read_beacon(os.path.join(self.run_dir, SERVICE_JSON))
+        if info is None or info.get("pid") != self.proc.pid:
             return []
         return [int(r["port"]) for r in info.get("replicas") or []
                 if isinstance(r, dict) and r.get("port")]

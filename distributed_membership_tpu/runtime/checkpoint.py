@@ -93,7 +93,11 @@ _IDENTITY_EXCLUDE = frozenset(
      # The fleet keys configure the CONTROLLER process, never the run's
      # per-tick math — a conf submitted to a fleet resumes bit-exactly
      # under a controller with different scheduling knobs (or none).
-     "FLEET_PORT", "FLEET_MAX_CONCURRENCY", "FLEET_DIR", "FLEET_LINGER"})
+     "FLEET_PORT", "FLEET_MAX_CONCURRENCY", "FLEET_DIR", "FLEET_LINGER",
+     # The watchdog (observability/watchdog.py) only OBSERVES host-side
+     # artifacts (runlog, beacons, the published snapshot metadata) —
+     # a resume may toggle it freely.
+     "WATCHDOG"})
 
 
 def params_identity(params: Params) -> str:
@@ -360,33 +364,28 @@ def _crash_tick() -> Optional[int]:
 
 def _state_reporter(total: int) -> Optional[Callable[[int], None]]:
     """The fleet worker's progress beacon: a callable writing
-    ``{tick, total, ts}`` to ``$DM_RUN_STATE_FILE`` (atomic rename, so
-    a reader never sees a torn file), or None when the env is unset.
-    Best-effort by design — a full disk must not kill the run over a
-    progress report the checkpoints already imply."""
+    ``{tick, total, ts}`` to ``$DM_RUN_STATE_FILE`` (the shared
+    observability/beacon.py format — atomic rename, so a reader never
+    sees a torn file), or None when the env is unset.  Best-effort by
+    design — a full disk must not kill the run over a progress report
+    the checkpoints already imply."""
     path = os.environ.get(STATE_FILE_ENV)
     if not path:
         return None
+    from distributed_membership_tpu.observability.beacon import (
+        write_beacon)
 
     def report(tick: int) -> None:
-        def _write(tmp):
-            with open(tmp, "w") as fh:
-                json.dump({"tick": int(tick), "total": int(total),
-                           "ts": time.time()}, fh)
-        try:
-            _atomic_write(path, _write)
-        except OSError:
-            pass
+        write_beacon(path, {"tick": int(tick), "total": int(total),
+                            "ts": time.time()})
     return report
 
 
 def read_run_state(path: str) -> Optional[dict]:
     """The beacon's current value, or None (absent/torn)."""
-    try:
-        with open(path) as fh:
-            return json.load(fh)
-    except (OSError, json.JSONDecodeError):
-        return None
+    from distributed_membership_tpu.observability.beacon import (
+        read_beacon)
+    return read_beacon(path)
 
 
 class RunInterrupted(RuntimeError):

@@ -18,6 +18,7 @@ these very functions; fleet/daemon.py never re-implements a route).
 Endpoints (README "Service"):
 
   GET  /healthz               liveness + run phase + snapshot tick
+  GET  /metrics               Prometheus text (observability/metricsbus)
   GET  /v1/census             cluster-level counts from the snapshot
   GET  /v1/member/<id>        one member's O(1) record
   GET  /v1/timeline?from=T    merged per-tick series from timeline.jsonl
@@ -90,9 +91,10 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _json(self, code: int, obj: dict) -> None:
         self._body(code, (json.dumps(obj) + "\n").encode())
 
-    def _body(self, code: int, body: bytes) -> None:
+    def _body(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -123,6 +125,13 @@ def route_get(h: ApiHandler, state, upath: str, query: str) -> None:
     """The run-surface GET routes, mount-point agnostic: ``upath`` has
     any prefix already stripped.  ``state`` is the daemon's
     ControlState; ``h`` the handler to reply on."""
+    if upath == "/metrics":
+        # Before count_query: a scraper polling every second must not
+        # inflate the query-tier q/s it is trying to observe.
+        text = state.metrics_text()
+        h._body(200, text.encode(),
+                ctype="text/plain; version=0.0.4; charset=utf-8")
+        return
     state.count_query()
 
     def _snapshot():
@@ -251,7 +260,15 @@ def make_server(state, port: int) -> ThreadingHTTPServer:
             # partition, not urlparse: census/member are the bench's
             # hot path and carry no query string.
             upath, _, query = self.path.partition("?")
-            route_get(self, state, upath, query)
+            # Sampled server-side latency (the replica pool's scheme,
+            # via the shared reservoir) when the state carries one.
+            lat = getattr(state, "lat", None)
+            if lat is not None and lat.should_sample(state.queries):
+                t0 = time.perf_counter()
+                route_get(self, state, upath, query)
+                lat.record((time.perf_counter() - t0) * 1e3)
+            else:
+                route_get(self, state, upath, query)
 
         def _route_post(self):
             route_post(self, state, self.path)

@@ -37,6 +37,9 @@ from typing import List, Optional
 
 from distributed_membership_tpu.config import Params
 from distributed_membership_tpu.eventlog import EventLog
+from distributed_membership_tpu.observability import metricsbus, spans
+from distributed_membership_tpu.observability.beacon import (
+    read_beacon, write_beacon)
 from distributed_membership_tpu.observability.metrics import write_msgcount
 from distributed_membership_tpu.service.events import (
     JOURNAL_NAME, EventJournal, apply_merge, base_events,
@@ -133,6 +136,15 @@ class SnapshotPublisher(threading.Thread):
             except Exception:
                 pass
 
+    def backlog_ticks(self) -> int:
+        """Submitted-minus-published tick gap — the watchdog's and
+        /metrics' backlog signal (0 = the publisher is caught up)."""
+        with self._cv:
+            s, p = self._submitted, self._published
+        if s is None:
+            return 0
+        return max(int(s) - int(p or 0), 0)
+
     def drain(self, timeout_s: float = 120.0) -> bool:
         deadline = time.monotonic() + timeout_s
         with self._cv:
@@ -181,6 +193,53 @@ class ControlState:
         self.shm_name: Optional[str] = None
         self._lock = threading.Lock()
         self._inject_unsupported = injection_unsupported(params)
+        # Metrics plane: the engine daemon's /metrics registry.  Under
+        # a multi-process launch the proc index rides as a const label
+        # so the fleet union can tell the shards apart.
+        proc = os.environ.get("DM_DIST_PROC_ID", "")
+        self.metrics = metricsbus.MetricsRegistry(
+            constlabels={"proc": proc} if proc else None)
+        m = self.metrics
+        self._m_queries = m.counter(
+            "dm_queries_total", "Queries served by this surface")
+        self._m_qps = m.gauge(
+            "dm_queries_per_sec", "Query rate since the last scrape")
+        self._m_p50 = m.gauge(
+            "dm_query_p50_ms", "Sampled query latency p50 (ms)")
+        self._m_p99 = m.gauge(
+            "dm_query_p99_ms", "Sampled query latency p99 (ms)")
+        self._m_tick = m.gauge(
+            "dm_engine_tick", "Engine tick at the last boundary")
+        self._m_total = m.gauge(
+            "dm_run_total_ticks", "Configured run length in ticks")
+        self._m_snap_tick = m.gauge(
+            "dm_snapshot_tick", "Tick of the freshest served snapshot")
+        self._m_snap_age = m.gauge(
+            "dm_snapshot_age_seconds",
+            "Seconds since the served snapshot was decoded")
+        self._m_snap_lag = m.gauge(
+            "dm_snapshot_lag_ticks",
+            "Engine tick minus served snapshot tick")
+        self._m_pending = m.gauge(
+            "dm_pending_events", "Accepted injections awaiting a "
+            "segment boundary")
+        self._m_applied = m.gauge(
+            "dm_applied_events", "Injections merged into the plan")
+        self._m_publishes = m.counter(
+            "dm_publisher_publishes_total",
+            "Snapshots the publisher thread derived and published")
+        self._m_backlog = m.gauge(
+            "dm_publisher_backlog_ticks",
+            "Publisher submitted-minus-published tick gap")
+        self.lat = metricsbus.LatencyReservoir()
+        self._rate = metricsbus.ScrapeRate()
+        # Event tracing (observability/spans.py): serve_run arms the
+        # SpanLog; the seq counter is the journal position so resume
+        # replay re-derives identical event ids.
+        self.spans: Optional[spans.SpanLog] = None
+        self.watchdog = None
+        self._event_seq = 0
+        self._pending_ids: List[str] = []
         # The run mesh (tpu_hash_sharded only), resolved ONCE by
         # serve_run and shared with the injection hook: the recompiled
         # merged runner must close over the very mesh the engine runs
@@ -191,6 +250,34 @@ class ControlState:
     def count_query(self) -> None:
         with self._lock:
             self.queries += 1
+
+    def record_latency(self, ms: float) -> None:
+        self.lat.record(ms)
+
+    def metrics_text(self) -> str:
+        """GET /metrics: refresh the live gauges, render the registry.
+        Runs on a handler thread — never the engine thread."""
+        snap = self.store.get()
+        q = self.queries
+        self._m_queries.set_total(q)
+        self._m_qps.set(self._rate.rate(q))
+        pct = self.lat.percentiles()
+        if pct["p50_ms"] is not None:
+            self._m_p50.set(pct["p50_ms"])
+            self._m_p99.set(pct["p99_ms"])
+        self._m_tick.set(self.tick)
+        self._m_total.set(self.total)
+        self._m_snap_tick.set(-1 if snap is None else snap.tick)
+        if snap is not None:
+            self._m_snap_age.set(
+                round(time.time() - snap.decoded_at, 3))
+            self._m_snap_lag.set(max(self.tick - snap.tick, 0))
+        self._m_pending.set(len(self.pending))
+        self._m_applied.set(len(self.applied))
+        if self.publisher is not None:
+            self._m_publishes.set_total(self.publisher.publishes)
+            self._m_backlog.set(self.publisher.backlog_ticks())
+        return self.metrics.render()
 
     def health(self) -> dict:
         snap = self.store.get()
@@ -256,7 +343,18 @@ class ControlState:
                 # Durability before the ACK: an acknowledged event
                 # survives any kill (RESUME replays the journal).
                 self.journal.append(events)
+            ids = []
+            for ev in events:
+                ids.append(spans.event_id(ev, self._event_seq))
+                self._event_seq += 1
             self.pending.extend(events)
+            self._pending_ids.extend(ids)
+        if self.spans is not None:
+            for eid, ev in zip(ids, events):
+                self.spans.stamp(eid, "accepted", tick=self.tick,
+                                 event=ev)
+                if self.journal is not None:
+                    self.spans.stamp(eid, "journaled", tick=self.tick)
         return 202, {"accepted": len(events), "apply_at_tick": next_tick,
                      "journaled": self.journal is not None}
 
@@ -305,10 +403,33 @@ def _make_hook(state: ControlState):
                         decode_state(carry, tick, n, tfail))
                 except AttributeError as e:   # undecodable carry
                     state.snapshot_error = str(e)
+        if i == 0 and state.spans is not None and state.applied:
+            # Resume: the journal replay merged state.applied before
+            # the first segment — stamp whatever stages the previous
+            # life's spans.jsonl is missing (ids are deterministic in
+            # journal order, so stamps land on the same spans; stages
+            # already present are left alone — last-wins would clobber
+            # the original wall clocks).
+            have = spans.read_spans(state.spans.path)
+            for seq, ev in enumerate(state.applied):
+                eid = spans.event_id(ev, seq)
+                stages = have.get(eid, {})
+                if "accepted" not in stages:
+                    state.spans.stamp(eid, "accepted", tick=tick,
+                                      event=ev, replayed=True)
+                if "journaled" not in stages:
+                    state.spans.stamp(eid, "journaled", tick=tick,
+                                      replayed=True)
+                if "compiled" not in stages:
+                    state.spans.stamp(eid, "compiled", tick=tick,
+                                      replayed=True)
         upd = {}
         with state._lock:
             state.tick = tick
             drained, state.pending = state.pending, []
+            drained_ids, state._pending_ids = state._pending_ids, []
+        if state.watchdog is not None:
+            state.watchdog.notify(tick)     # one Event.set — O(1)
         if drained:
             state.applied.extend(drained)
             state.applied_at.append({"tick": int(tick),
@@ -342,6 +463,11 @@ def _make_hook(state: ControlState):
                                   scenario=state.plan.scenario.static)
                 upd["segment_fn"] = _get_segment_runner(cfg, warm)
             upd["extra_inputs"] = (state.plan.scenario.tensors(),)
+            if state.spans is not None:
+                # The merged runner takes effect from THIS boundary's
+                # next segment — the tick the injection is live from.
+                for eid in drained_ids:
+                    state.spans.stamp(eid, "compiled", tick=tick)
         if state.stop_event.is_set():
             upd["stop"] = True
         return upd or None
@@ -374,16 +500,12 @@ def port_in_use_hint(err, out_dir: str) -> str:
     collision is re-serving an out-dir whose daemon is still up)."""
     lines = [f"service: cannot bind — {err.strerror}; pick another "
              "--port (or 0 for ephemeral), or stop the owner"]
-    try:
-        with open(os.path.join(out_dir, SERVICE_JSON)) as fh:
-            info = json.load(fh)
-        if info.get("port") == err.port:
-            lines.append(
-                f"service: {SERVICE_JSON} in {out_dir!r} records pid "
-                f"{info.get('pid')} serving this run dir on port "
-                f"{err.port} — that daemon likely still owns it")
-    except (OSError, ValueError):
-        pass
+    info = read_beacon(os.path.join(out_dir, SERVICE_JSON))
+    if info is not None and info.get("port") == err.port:
+        lines.append(
+            f"service: {SERVICE_JSON} in {out_dir!r} records pid "
+            f"{info.get('pid')} serving this run dir on port "
+            f"{err.port} — that daemon likely still owns it")
     return "\n".join(lines)
 
 
@@ -397,8 +519,7 @@ def _write_service_json(out_dir: str, state: ControlState) -> None:
                            for r in state.replicas]
     if state.shm_name:
         doc["shm"] = state.shm_name
-    with open(os.path.join(out_dir, SERVICE_JSON), "w") as fh:
-        json.dump(doc, fh, indent=1)
+    write_beacon(os.path.join(out_dir, SERVICE_JSON), doc)
 
 
 def _leash_sigterm():
@@ -595,6 +716,29 @@ def serve_run(params: Params, seed: Optional[int] = None,
         print(f"service: {len(replicas)} read replica(s) on ports "
               f"{[r['port'] for r in replicas]}", flush=True)
 
+    # Event tracing: spans.jsonl beside the run (observability/
+    # spans.py).  A fresh run clears the previous run's spans, the
+    # same posture as journal.reset(); a resume keeps them so the
+    # replay stamps land on the prior life's records.
+    state.spans = spans.SpanLog(os.path.join(out_dir,
+                                             spans.SPANS_NAME))
+    if not params.RESUME:
+        try:
+            os.unlink(state.spans.path)
+        except OSError:
+            pass
+    watchdog = None
+    if getattr(params, "WATCHDOG", 1):
+        from distributed_membership_tpu.observability.runlog import (
+            maybe_runlog)
+        from distributed_membership_tpu.observability.watchdog import (
+            Watchdog)
+        watchdog = Watchdog(
+            state, out_dir,
+            runlog=maybe_runlog(params.TELEMETRY_DIR or out_dir))
+        state.watchdog = watchdog
+        watchdog.start()
+
     _write_service_json(out_dir, state)
     print(f"service: listening on 127.0.0.1:{state.port} "
           f"(pid {os.getpid()})", flush=True)
@@ -628,6 +772,8 @@ def serve_run(params: Params, seed: Optional[int] = None,
             pass
         return 0
     finally:
+        if watchdog is not None:
+            watchdog.close()
         server.shutdown()
         server.server_close()
         state.publisher.close()

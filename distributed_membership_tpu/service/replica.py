@@ -20,8 +20,9 @@ pool) and exits, so a SIGKILLed daemon leaks no /dev/shm segment.  An
 individually killed replica (SIGTERM) just exits WITHOUT unlinking:
 the ring still feeds its surviving siblings.
 
-Each replica drops a ``replica_<i>.json`` beacon (atomic rename, the
-run_state.json pattern) next to the run every second: queries served,
+Each replica drops a ``replica_<i>.json`` beacon (the shared
+observability/beacon.py format) next to the run every second: queries
+served,
 q/s over the last interval, sampled server-side p50/p99, snapshot
 tick/generation and the engine-tick lag — scripts/run_report.py
 renders these as the query-tier rows.
@@ -38,12 +39,12 @@ import threading
 import time
 from typing import Optional
 
+from distributed_membership_tpu.observability import metricsbus
+from distributed_membership_tpu.observability.beacon import write_beacon
 from distributed_membership_tpu.service import api
 from distributed_membership_tpu.service.shm_ring import ShmRingReader
 
 BEACON_INTERVAL_S = 1.0
-_LAT_SAMPLE_EVERY = 16          # sample 1 in K requests
-_LAT_WINDOW = 512               # sliding reservoir size
 
 
 class ShmSnapshot:
@@ -123,28 +124,52 @@ class ReplicaState:
         self.stop_event = threading.Event()
         self._timeline = timeline or None
         self._lock = threading.Lock()
-        self._lat_ms = []           # sliding sample reservoir
+        self.lat = metricsbus.LatencyReservoir()
+        self._metrics = metricsbus.MetricsRegistry(
+            constlabels={"replica": str(index)})
+        m = self._metrics
+        self._m_queries = m.counter(
+            "dm_queries_total", "Queries served by this surface")
+        self._m_qps = m.gauge(
+            "dm_queries_per_sec", "Query rate since the last scrape")
+        self._m_p50 = m.gauge(
+            "dm_query_p50_ms", "Sampled query latency p50 (ms)")
+        self._m_p99 = m.gauge(
+            "dm_query_p99_ms", "Sampled query latency p99 (ms)")
+        self._m_snap_tick = m.gauge(
+            "dm_snapshot_tick", "Tick of the freshest served snapshot")
+        self._m_eng_tick = m.gauge(
+            "dm_engine_tick", "Engine tick (from the ring header)")
+        self._m_lag = m.gauge(
+            "dm_snapshot_lag_ticks",
+            "Engine tick minus served snapshot tick")
+        self._rate = metricsbus.ScrapeRate()
 
     def count_query(self) -> None:
         with self._lock:
             self.queries += 1
 
     def record_latency(self, ms: float) -> None:
-        with self._lock:
-            self._lat_ms.append(ms)
-            if len(self._lat_ms) > _LAT_WINDOW:
-                del self._lat_ms[:len(self._lat_ms) - _LAT_WINDOW]
+        self.lat.record(ms)
 
     def latency_percentiles(self) -> dict:
-        with self._lock:
-            lat = sorted(self._lat_ms)
-        if not lat:
-            return {"p50_ms": None, "p99_ms": None}
-        return {
-            "p50_ms": round(lat[len(lat) // 2], 4),
-            "p99_ms": round(lat[min(len(lat) - 1,
-                                    int(len(lat) * 0.99))], 4),
-        }
+        return self.lat.percentiles()
+
+    def metrics_text(self) -> str:
+        eng = self.reader.engine()
+        snap = self.store.get()
+        q = self.queries
+        self._m_queries.set_total(q)
+        self._m_qps.set(self._rate.rate(q))
+        pct = self.lat.percentiles()
+        if pct["p50_ms"] is not None:
+            self._m_p50.set(pct["p50_ms"])
+            self._m_p99.set(pct["p99_ms"])
+        self._m_eng_tick.set(eng["tick"])
+        self._m_snap_tick.set(-1 if snap is None else snap.tick)
+        self._m_lag.set(-1 if snap is None
+                        else max(eng["tick"] - snap.tick, 0))
+        return self._metrics.render()
 
     def health(self) -> dict:
         eng = self.reader.engine()
@@ -179,7 +204,7 @@ def make_replica_server(state: ReplicaState, port: int):
     class Handler(api.ApiHandler):
         def _route_get(self):
             upath, _, query = self.path.partition("?")
-            if state.queries % _LAT_SAMPLE_EVERY == 0:
+            if state.lat.should_sample(state.queries):
                 t0 = time.perf_counter()
                 api.route_get(self, state, upath, query)
                 state.record_latency((time.perf_counter() - t0) * 1e3)
@@ -220,17 +245,9 @@ def _write_beacon(state: ReplicaState, out_dir: str,
         "engine_status": eng["status"],
         "tick_lag": (None if snap is None
                      else max(eng["tick"] - snap.tick, 0)),
-        "time": time.time(),
     }
     doc.update(state.latency_percentiles())
-    path = beacon_path(out_dir, state.index)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    try:
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, path)
-    except OSError:
-        pass
+    write_beacon(beacon_path(out_dir, state.index), doc)
     return {"t": now, "q": q}
 
 

@@ -97,6 +97,12 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--timeout", type=float, default=None,
                     help="per-run wall clock limit in seconds")
+    ap.add_argument("--merge", action="store_true",
+                    help="after all processes exit 0, fold the per-"
+                    "process p{i}/timeline.jsonl shards into "
+                    "<out-root>/timeline.jsonl with the consistency "
+                    "cross-check (observability/merge.py); shard "
+                    "disagreement exits 3")
     ap.add_argument("extra", nargs="*",
                     help="extra args forwarded to every CLI invocation "
                     "(put dashed args after a standalone `--`, e.g. "
@@ -138,6 +144,23 @@ def main(argv=None) -> int:
                 p.kill()
                 p.wait()
             logf.close()
+    if args.merge and rc == 0:
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        from distributed_membership_tpu.observability.merge import (
+            MergeError, merge_run)
+        try:
+            info = merge_run(os.path.abspath(args.out_root))
+        except MergeError as e:
+            print(f"[multiproc] merge cross-check FAILED: {e}",
+                  file=sys.stderr)
+            return 3
+        if info is None:
+            print("[multiproc] merge: no timeline shards (run with "
+                  "--telemetry scalars/hist)", file=sys.stderr)
+        else:
+            print(f"[multiproc] merged {len(info['shards'])} shard(s) "
+                  f"({info['ticks']} ticks) -> {info['path']}")
     return rc
 
 

@@ -7,8 +7,14 @@ Renders the three recorder streams into a single report:
   * ``summary.json`` (the detection verdicts finish_run drops next to
     the timeline), plus
   * ``runlog.jsonl`` (observability/runlog.py): per-segment
-    wall / device-sync / checkpoint-write-overlap timings and
-    compile-vs-execute events, and optionally
+    wall / device-sync / checkpoint-write-overlap timings,
+    compile-vs-execute events, and watchdog alerts
+    (observability/watchdog.py — rendered both as inline timeline
+    markers and as a per-rule count table), plus
+  * ``spans.jsonl`` (observability/spans.py): per-injected-event
+    stage traces (accepted → … → visible_at_replica), cross-checked
+    against the scenario oracle when a ``scenario.json`` report is
+    present, and optionally
   * a ladder event log (``artifacts/ladder_events.jsonl``): per-rung
     start/land/fail/retry/resume provenance.
 
@@ -56,6 +62,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+from distributed_membership_tpu.observability import merge, spans  # noqa: E402
+from distributed_membership_tpu.observability.beacon import (  # noqa: E402
+    read_beacon)
 from distributed_membership_tpu.observability.latency_dist import (  # noqa: E402
     slo_verdict)
 from distributed_membership_tpu.observability.runlog import (  # noqa: E402
@@ -136,23 +145,42 @@ def _ladder_stats(events: list) -> dict:
 def _replica_beacons(directory: str) -> list:
     """The query tier's ``replica_<i>.json`` beacons (one per read
     replica, rewritten every second — service/replica.py), sorted by
-    replica index.  Beacons whose ``time`` stamp is older than 10s are
-    marked stale (a dead replica's last beacon stays on disk)."""
+    replica index, parsed by the shared torn-tolerant reader
+    (observability/beacon.py).  Beacons whose ``time`` stamp is older
+    than 10s are marked stale (a dead replica's last beacon stays on
+    disk)."""
     import glob
     rows = []
     now = time.time()
     for path in sorted(glob.glob(os.path.join(directory,
                                               "replica_*.json"))):
-        try:
-            with open(path) as fh:
-                doc = json.load(fh)
-        except (OSError, ValueError):
-            continue
-        if not isinstance(doc, dict) or doc.get("role") != "replica":
+        doc = read_beacon(path)
+        if doc is None or doc.get("role") != "replica":
             continue
         doc["stale"] = bool(now - doc.get("time", 0) > 10)
         rows.append(doc)
     rows.sort(key=lambda d: d.get("index", 0))
+    return rows
+
+
+def _span_rows(span_map: dict) -> list:
+    """One row per traced event: the tick each stage landed at, plus
+    the span's own detection latency when stamped."""
+    rows = []
+    for eid in sorted(span_map):
+        stages = span_map[eid]
+        row: dict = {"event_id": eid}
+        for s in spans.STAGES:
+            rec = stages.get(s)
+            if rec is not None:
+                row[s] = rec.get("tick")
+        det = stages.get("first_detection") or {}
+        if det.get("latency_ticks") is not None:
+            row["latency_ticks"] = det["latency_ticks"]
+        vis = stages.get("visible_at_replica") or {}
+        if vis.get("replica") is not None:
+            row["replica"] = vis["replica"]
+        rows.append(row)
     return rows
 
 
@@ -170,21 +198,59 @@ def build_report(directory: str | None,
         tl_path = os.path.join(directory, TIMELINE_NAME)
         if os.path.exists(tl_path):
             series = read_timeline(tl_path)
+        else:
+            # A multiproc out-root: merge the p{i} shards in memory
+            # (verify + union — observability/merge.py); a shard
+            # disagreement is reported, not raised, so the rest of the
+            # artifacts still render.
+            shards = merge.shard_dirs(directory)
+            if shards:
+                try:
+                    series = merge.merged_series(
+                        merge.merge_paths(shards))
+                    report["merged_from"] = [lb for lb, _ in shards]
+                except merge.MergeError as e:
+                    report["merge_error"] = str(e)
+        if series.get("ticks", 0):
             report["timeline"] = timeline_summary(series)
             report["timeline"]["detections_so_far_final"] = (
                 int(series["detections_cum"][-1])
                 if len(series["detections_cum"]) else 0)
+            if report.get("merged_from"):
+                report["timeline"]["merged_shards"] = len(
+                    report["merged_from"])
         sm_path = os.path.join(directory, "summary.json")
         if os.path.exists(sm_path):
             with open(sm_path) as fh:
                 report["detection_summary"] = json.load(fh)
         rl_path = os.path.join(directory, "runlog.jsonl")
         if os.path.exists(rl_path):
-            report["segments"] = _segment_stats(read_events(rl_path))
+            events = read_events(rl_path)
+            report["segments"] = _segment_stats(events)
+            alert_rows = [e for e in events
+                          if e.get("kind") == "alert"]
+            if alert_rows:
+                by_rule: dict = {}
+                for a in alert_rows:
+                    r = a.get("rule", "?")
+                    by_rule[r] = by_rule.get(r, 0) + 1
+                report["alerts"] = {"total": len(alert_rows),
+                                    "by_rule": by_rule,
+                                    "rows": alert_rows}
         sc_path = os.path.join(directory, "scenario.json")
         if os.path.exists(sc_path):
             with open(sc_path) as fh:
                 report["scenario"] = json.load(fh)
+        sp_path = os.path.join(directory, spans.SPANS_NAME)
+        if os.path.exists(sp_path):
+            span_map = spans.read_spans(sp_path)
+            if span_map:
+                report["spans"] = _span_rows(span_map)
+                sc = report.get("scenario")
+                if sc is not None:
+                    report["span_crosscheck"] = spans.crosscheck(
+                        span_map, sc,
+                        series=series if series.get("ticks") else None)
         replicas = _replica_beacons(directory)
         if replicas:
             report["query_tier"] = {
@@ -295,17 +361,73 @@ def _md_kv(d: dict) -> list:
 
 def render_markdown(report: dict) -> str:
     lines = ["# Flight-recorder run report", ""]
+    if report.get("merge_error"):
+        lines += [f"**MERGE ERROR**: {report['merge_error']}", ""]
+    if report.get("merged_from"):
+        lines += ["merged from shards: "
+                  + ", ".join(report["merged_from"]), ""]
     sc = report.get("scenario")
     tl = report.get("timeline")
+    al = report.get("alerts")
     if tl:
         lines += ["## Timeline (per-tick telemetry)", ""]
         if sc:
             # Scenario event markers inline, so the per-tick metrics
             # read against the chaos schedule that produced them.
             lines += [f"- {m}" for m in _scenario_markers(sc)]
+        if al:
+            # Watchdog alerts as inline markers too: a degradation
+            # reads in-place against the schedule that caused it.
+            for a in al["rows"]:
+                lines.append(
+                    f"- t={a.get('boundary_tick', '?')}: **ALERT** "
+                    f"{a.get('rule', '?')} "
+                    f"({a.get('severity', 'warn')})")
+        if sc or al:
             lines.append("")
         lines += ["| metric | value |", "|---|---|"]
         lines += _md_kv(tl)
+        lines.append("")
+    if al:
+        lines += ["## Watchdog alerts", "",
+                  f"{al['total']} rising edge(s)", "",
+                  "| rule | count |", "|---|---|"]
+        lines += _md_kv(al["by_rule"])
+        lines.append("")
+    sp = report.get("spans")
+    if sp:
+        lines += ["## Event spans (injection tracing)", "",
+                  "| event | accepted | journaled | compiled | "
+                  "first detection | removal | visible@replica | "
+                  "latency |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for r in sp:
+            def _c(key, row=r):
+                v = row.get(key)
+                return "-" if v is None else str(v)
+            vis = _c("visible_at_replica")
+            if r.get("replica") is not None and vis != "-":
+                vis += f" (r{r['replica']})"
+            lines.append(
+                f"| {r['event_id']} | {_c('accepted')} | "
+                f"{_c('journaled')} | {_c('compiled')} | "
+                f"{_c('first_detection')} | {_c('removal')} | "
+                f"{vis} | {_c('latency_ticks')} |")
+        xc = report.get("span_crosscheck")
+        if xc:
+            lines += ["", "span ↔ oracle cross-check:", "",
+                      "| event | latency supported | removal in "
+                      "window | ordered | consistent |",
+                      "|---|---|---|---|---|"]
+            for r in xc:
+                def _b(key, row=r):
+                    v = row.get(key)
+                    return "-" if v is None else ("ok" if v
+                                                  else "FAIL")
+                lines.append(
+                    f"| {r['event_id']} | {_b('latency_supported')} |"
+                    f" {_b('removal_in_window')} | {_b('ordered')} | "
+                    f"{'ok' if r['consistent'] else 'FAIL'} |")
         lines.append("")
     if sc:
         lines += [f"## Scenario oracle — {sc.get('scenario', '?')}", "",
@@ -486,12 +608,17 @@ def fleet_report(root: str) -> dict:
     for rid in sorted(runs, key=lambda r: runs[r]["seq"]):
         row = runs[rid]
         run_dir = os.path.join(root, rid)
-        try:
-            with open(os.path.join(run_dir, "run_state.json")) as fh:
+        st = read_beacon(os.path.join(run_dir, "run_state.json"))
+        if st is not None:
+            try:
                 row["tick"] = max(row["tick"],
-                                  int(json.load(fh).get("tick", 0)))
-        except (OSError, ValueError):
-            pass
+                                  int(st.get("tick", 0)))
+            except (TypeError, ValueError):
+                pass
+        alerts = read_events(os.path.join(run_dir, "runlog.jsonl"),
+                             kinds=("alert",))
+        if alerts:
+            row["alerts"] = len(alerts)
         live = _tail_field(os.path.join(run_dir, TIMELINE_NAME),
                            "live")
         if isinstance(live, list):     # chunked rows carry per-tick
@@ -591,6 +718,8 @@ def render_fleet(report: dict) -> str:
                    else r["query_lag"])
             line += (f"  query {r['query_qps']} q/s "
                      f"x{r['query_replicas']} lag {lag}")
+        if r.get("alerts"):
+            line += f"  ALERTS {r['alerts']}"
         lines.append(line)
     return "\n".join(lines)
 

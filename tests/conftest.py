@@ -65,6 +65,10 @@ _QUICK = {
                              "test_folded_fused_config_gates"},
     "test_shell_oracle.py": {"test_magic_first_line"},
     "test_package_results.py": {"test_package_results_archive"},
+    "test_metrics_plane.py": {
+        "test_registry_golden_text",
+        "test_watchdog_rules_synthetic",
+        "test_merge_verify_union_and_divergence"},
     "test_query_tier.py": {
         "test_incremental_derive_matches_full_oracle[64]",
         "test_shm_ring_roundtrip_delta_and_seqlock",

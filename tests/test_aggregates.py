@@ -31,7 +31,14 @@ def _params(backend, n=128, extra=""):
         f"BACKEND: {backend}\n" + extra)
 
 
-@pytest.mark.parametrize("backend", ["tpu_sparse", "tpu_hash"])
+# tpu_hash carries the agg-vs-full-extraction contract in tier-1
+# (~5s vs ~10s); the sparse arm rides the slow tier — the sparse
+# backend itself stays tier-1-covered by tests/test_sparse_backend.py
+# and the grader passes in test_grade_all.py.
+@pytest.mark.parametrize("backend", [
+    pytest.param("tpu_sparse", marks=pytest.mark.slow),
+    "tpu_hash",
+])
 def test_agg_matches_full_events(backend):
     mod = __import__(f"distributed_membership_tpu.backends.{backend}",
                      fromlist=["run_scan"])

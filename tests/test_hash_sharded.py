@@ -42,7 +42,13 @@ def test_removal_latency_in_reference_window(testcases_dir):
     assert set(lat) <= {21, 22, 23}, lat
 
 
-@pytest.mark.parametrize("exchange", ["ring", "scatter"])
+# Ring carries the tier-1 leg (3.5s vs scatter's 18s at this N);
+# scatter-on-mesh keeps tier-1 coverage at smaller shapes
+# (test_hash_backend / test_aggregates / test_timeline).
+@pytest.mark.parametrize("exchange", [
+    "ring",
+    pytest.param("scatter", marks=pytest.mark.slow),
+])
 def test_warm_scale_detection_on_mesh(exchange):
     # Ring's refresh-chain tail runs a little longer than scatter's
     # (tests/test_hash_backend.py), hence the per-mode latency slack.
@@ -86,9 +92,12 @@ def test_rack_failure_on_mesh():
     assert s["detected_by_someone"] == 1.0
 
 
-def test_mesh_matches_single_chip_distribution():
+@pytest.mark.slow       # 6 full N=512 runs; tier-1 keeps the sharded
+def test_mesh_matches_single_chip_distribution():  # vs single-chip
     """Sharded and single-chip tpu_hash agree distributionally: same
-    config/seed list, detection latency medians within a couple of ticks."""
+    config/seed list, detection latency medians within a couple of
+    ticks.  (Tier-1 agreement coverage stays via the grader-parity and
+    latency-window tests at N=10/100.)"""
     conf = ("MAX_NNB: 512\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
             "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 5\nFANOUT: 3\n"
             "TOTAL_TIME: 150\nFAIL_TIME: 100\nJOIN_MODE: warm\n"

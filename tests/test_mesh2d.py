@@ -54,7 +54,8 @@ def test_2d_torus_bit_exact_vs_flat():   # ~21 s: full-tier
     assert _mismatch(e1, e2) == 0
 
 
-def test_2d_torus_bit_exact_4x2_and_8x1():
+@pytest.mark.slow   # ~5 s; tier-1 keeps the 2x4-vs-flat arm above, and
+def test_2d_torus_bit_exact_4x2_and_8x1():    # test_exchange's 2x4 torus
     """Other factorizations of the same device count agree too — 8x1 is
     the degenerate torus (pure outer rotations, carry never fires)."""
     p = _params()

@@ -1,0 +1,567 @@
+"""The fleet-wide metrics plane (observability/metricsbus.py, merge.py,
+spans.py, watchdog.py + the /metrics routes on all three surfaces).
+
+Five layers:
+
+  * **Exposition golden test** — the registry's Prometheus text is
+    pinned byte-for-byte (deterministic family/label ordering is a
+    design constraint), ``parse_text`` is its strict inverse, and
+    ``relabel`` injects fleet labels without clobbering the surface's
+    own (the surface closest to the data wins).
+  * **Watchdog rules** — the four pure rules driven with synthetic
+    degradation (no run needed), plus the thread's rising-edge dedup:
+    a persistent trip is ONE alert record until the rule recovers and
+    re-arms.
+  * **Telemetry merge** — verify + union semantics on synthetic
+    shards: overlapping segments must agree bitwise (disagreement is a
+    hard MergeError naming shard/field/tick), disjoint segments union,
+    torn trailing lines are skipped; then the real thing, slow-marked:
+    a 2-process N=2048 launcher run with ``--merge`` produces a merged
+    timeline bit-identical to the single-process twin's.
+  * **Surfaces** — the replica's ``/metrics`` state (const
+    ``replica`` label, ring-fed gauges) and the fleet union: own
+    gauges + scraped worker text relabeled with ``run_id`` + gauges
+    synthesized from replica beacons (dead-pid beacons dropped), and
+    the summary's per-run alert counts.
+  * **Span lifecycle** — a served run: inject, read /metrics mid-run,
+    stop at a boundary (the SIGTERM park), tear the spans tail the way
+    a SIGKILL mid-append would, ``--resume`` to completion — event ids
+    re-derive identically from the replayed journal, prior stamps
+    survive (last-wins), every stage lands, and the span latencies
+    reconcile with the scenario oracle (``crosscheck``).
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.observability import (
+    merge, metricsbus, spans)
+from distributed_membership_tpu.observability import watchdog as wd
+from distributed_membership_tpu.observability.beacon import write_beacon
+from distributed_membership_tpu.observability.runlog import (
+    RunLog, read_events)
+from distributed_membership_tpu.observability.timeline import (
+    TIMELINE_NAME, read_timeline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# metricsbus: golden exposition text, strict parse, relabel
+
+
+def test_registry_golden_text():
+    reg = metricsbus.MetricsRegistry(constlabels={"proc": "0"})
+    q = reg.counter("dm_queries_total", "Queries served")
+    t = reg.gauge("dm_engine_tick", "Engine tick")
+    h = reg.histogram("dm_lat_ms", "Query latency", buckets=(1, 5))
+    q.inc()
+    q.inc()
+    t.set(30)
+    h.observe(0.5)
+    h.observe(7)
+    assert reg.render() == (
+        "# HELP dm_queries_total Queries served\n"
+        "# TYPE dm_queries_total counter\n"
+        'dm_queries_total{proc="0"} 2\n'
+        "# HELP dm_engine_tick Engine tick\n"
+        "# TYPE dm_engine_tick gauge\n"
+        'dm_engine_tick{proc="0"} 30\n'
+        "# HELP dm_lat_ms Query latency\n"
+        "# TYPE dm_lat_ms histogram\n"
+        'dm_lat_ms_bucket{proc="0",le="1"} 1\n'
+        'dm_lat_ms_bucket{proc="0",le="5"} 1\n'
+        'dm_lat_ms_bucket{proc="0",le="+Inf"} 2\n'
+        'dm_lat_ms_sum{proc="0"} 7.5\n'
+        'dm_lat_ms_count{proc="0"} 2\n')
+    parsed = metricsbus.parse_text(reg.render())
+    assert parsed[("dm_queries_total", (("proc", "0"),))] == 2
+    assert parsed[("dm_engine_tick", (("proc", "0"),))] == 30
+    assert parsed[("dm_lat_ms_sum", (("proc", "0"),))] == 7.5
+    assert parsed[("dm_lat_ms_bucket",
+                   (("le", "+Inf"), ("proc", "0")))] == 2
+    # Same-name re-registration returns the same instrument; a type
+    # flip is refused.
+    assert reg.counter("dm_queries_total", "dup") is q
+    with pytest.raises(ValueError, match="different type"):
+        reg.gauge("dm_queries_total", "flip")
+
+
+def test_parse_and_relabel_roundtrip():
+    # Escaped label values round-trip through render -> parse.
+    reg = metricsbus.MetricsRegistry()
+    g = reg.gauge("dm_x", "x")
+    g.set(1, name='a"b\\c')
+    ((_, labels),) = metricsbus.parse_text(reg.render()).keys()
+    assert labels == (("name", 'a"b\\c'),)
+    for bad in ("dm_x 1 2 3\n", "dm_x{a=} 1\n", "dm_x nope\n"):
+        with pytest.raises(ValueError):
+            metricsbus.parse_text(bad)
+    # relabel injects without overriding: the surface's own run_id
+    # wins, unlabeled samples gain the fleet's.
+    text = ('# HELP dm_y y\ndm_y{run_id="mine"} 1\n'
+            "dm_z 2\n")
+    out = metricsbus.parse_text(
+        metricsbus.relabel(text, {"run_id": "fleet"}))
+    assert out[("dm_y", (("run_id", "mine"),))] == 1
+    assert out[("dm_z", (("run_id", "fleet"),))] == 2
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: pure rules with synthetic degradation + rising-edge dedup
+
+
+def test_watchdog_rules_synthetic():
+    # tick_rate_collapse: median baseline, not mean — one slow compile
+    # segment must not drag the baseline with it.
+    assert wd.rule_tick_rate([100.0, 100.0, 10.0]) is None  # too short
+    trip = wd.rule_tick_rate([100.0, 2.0, 100.0, 100.0, 10.0])
+    assert trip["rule"] == "tick_rate_collapse"
+    assert trip["baseline_per_s"] == 100.0
+    assert wd.rule_tick_rate([100.0, 100.0, 100.0, 80.0]) is None
+
+    # publisher_backlog: only a STRICTLY growing gap trips.
+    assert wd.rule_backlog([0.0, 2.0, 0.0, 2.0]) is None    # bouncing
+    trip = wd.rule_backlog([0.0, 1.0, 2.0, 3.0])
+    assert trip["rule"] == "publisher_backlog"
+    assert trip["backlog_ticks"] == 3.0
+    assert wd.rule_backlog([0.1, 0.2, 0.3]) is None   # under min_ticks
+
+    # replica_staleness: None = no fresh beacon = nothing to judge.
+    assert wd.rule_staleness(None, 120) is None
+    assert wd.rule_staleness(100, 120) is None
+    assert wd.rule_staleness(200, 120)["lag_ticks"] == 200
+
+    # detection_slo: unassessable (no hist tier / zero detections)
+    # never alerts; mass far from the banked reference does.
+    assert wd.rule_detection_slo(None) is None
+    assert wd.rule_detection_slo({"ticks": 1}) is None
+    zeros = {"h_latency": np.zeros((64,), np.int64)}
+    assert wd.rule_detection_slo(zeros) is None     # verdict withheld
+    ref = np.zeros((64,), np.int64)
+    ref[21], ref[22], ref[23] = 4, 4, 1
+    assert wd.rule_detection_slo({"h_latency": ref}) is None
+    off = np.zeros((64,), np.int64)
+    off[5] = 9
+    trip = wd.rule_detection_slo({"h_latency": off})
+    assert trip["rule"] == "detection_slo"
+    assert trip["severity"] == "error"
+    assert trip["max_cdf_deviation"] == 1.0
+
+
+class _StubParams:
+    CHECKPOINT_EVERY = 30
+    SERVICE_SNAPSHOT_EVERY = 1
+    TELEMETRY_DIR = ""
+
+
+class _StubState:
+    def __init__(self, registry):
+        self.params = _StubParams()
+        self.tick = 60
+        self.publisher = None
+        self.stop_event = threading.Event()
+        self.metrics = registry
+
+    def timeline_path(self):
+        return None
+
+
+def test_watchdog_rising_edge_dedup(tmp_path):
+    reg = metricsbus.MetricsRegistry()
+    runlog = RunLog(str(tmp_path / "runlog.jsonl"))
+    dog = wd.Watchdog(_StubState(reg), str(tmp_path), runlog=runlog)
+    collapsed = [100.0, 100.0, 100.0, 100.0, 10.0]
+    healthy = [100.0] * 5
+
+    dog._segment_rates = lambda: collapsed
+    dog.evaluate()
+    dog.evaluate()          # still tripped: no second record
+    assert dog.alert_counts() == {"tick_rate_collapse": 1}
+    dog._segment_rates = lambda: healthy
+    dog.evaluate()          # recovered: re-arms
+    dog._segment_rates = lambda: collapsed
+    dog.evaluate()          # second rising edge
+    assert dog.alert_counts() == {"tick_rate_collapse": 2}
+
+    alerts = read_events(str(tmp_path / "runlog.jsonl"),
+                         kinds=("alert",))
+    assert len(alerts) == 2
+    assert alerts[0]["rule"] == "tick_rate_collapse"
+    assert alerts[0]["boundary_tick"] == 60
+    assert alerts[0]["rate_per_s"] == 10.0
+    assert metricsbus.parse_text(reg.render())[
+        ("dm_watchdog_alerts_total",
+         (("rule", "tick_rate_collapse"),))] == 2
+
+
+# ---------------------------------------------------------------------------
+# Telemetry merge: verify + union on synthetic shards
+
+
+def _write_shard(root, name, records, torn=""):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, TIMELINE_NAME)
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+        fh.write(torn)
+    return path
+
+
+def _seg(t0, ticks, base):
+    from distributed_membership_tpu.observability.timeline import (
+        TELEMETRY_FIELDS)
+    rec = {f: [0] * ticks for f in TELEMETRY_FIELDS}
+    rec.update(t0=t0, ticks=ticks, live=[base] * ticks)
+    return rec
+
+
+def test_merge_verify_union_and_divergence(tmp_path):
+    root = str(tmp_path)
+    a, b = _seg(0, 24, 16), _seg(24, 24, 15)
+    c = _seg(48, 24, 15)                    # only p1 flushed this one
+    _write_shard(root, "p0", [a, b])
+    _write_shard(root, "p1", [a, b, c], torn='{"t0": 72, "tick')
+    info = merge.merge_run(root)
+    assert info["shards"] == ["p0", "p1"]
+    assert info["segments"] == 3 and info["ticks"] == 72
+    merged = read_timeline(os.path.join(root, TIMELINE_NAME))
+    assert merged["ticks"] == 72
+    assert list(merged["live"][:2]) == [16, 16]
+    assert len(merged["live"]) == 72
+
+    # A shard whose overlapping segment diverges is a hard error
+    # naming the shard pair, field, and first diverging tick.
+    bad = _seg(24, 24, 15)
+    bad["removals"][3] = 1
+    _write_shard(root, "p2", [bad])
+    with pytest.raises(merge.MergeError,
+                       match=r"'p2'.*t0=24.*'removals'.*tick 27"):
+        merge.merge_run(root, write=False)
+
+    assert merge.merge_run(str(tmp_path / "empty")) is None
+
+
+# ---------------------------------------------------------------------------
+# The read replica's /metrics state (ring-fed, const replica label)
+
+
+def test_replica_metrics_surface():
+    from test_query_tier import _World
+    from distributed_membership_tpu.service import shm_ring
+    from distributed_membership_tpu.service.replica import ReplicaState
+
+    w = _World(16, 4, 4, seed=7)
+    w.started[:] = True
+    snap = w.snap()
+    snap.precompute(None)
+    writer = shm_ring.ShmRingWriter(16, 4, np.uint32, np.int32, 4,
+                                    100, 2)
+    reader = None
+    state = None
+    try:
+        writer.set_engine("running", 42, 1)
+        writer.publish(snap, None)
+        reader = shm_ring.ShmRingReader(writer.name)
+        state = ReplicaState(reader, index=2, timeline=None)
+        state.count_query()
+        parsed = metricsbus.parse_text(state.metrics_text())
+        lbl = (("replica", "2"),)
+        assert parsed[("dm_queries_total", lbl)] == 1
+        assert parsed[("dm_engine_tick", lbl)] == 42
+        assert parsed[("dm_snapshot_tick", lbl)] == snap.tick
+        assert parsed[("dm_snapshot_lag_ticks", lbl)] == 42 - snap.tick
+    finally:
+        if state is not None:       # release the shm views first
+            state.store._cached = None
+        if reader is not None:
+            reader.close()
+        writer.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet union: own gauges + relabeled worker scrape + beacon synthesis
+
+
+_FLEET_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+               "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 50\n"
+               "TOTAL_TIME: 120\nJOIN_MODE: warm\nBACKEND: tpu_hash\n")
+
+_WORKER_TEXT = ("# HELP dm_engine_tick Engine tick\n"
+                "# TYPE dm_engine_tick gauge\n"
+                "dm_engine_tick 42\n"
+                'dm_queries_total{run_id="other"} 5\n')
+
+
+class _SchedStub:
+    max_concurrency = 1
+
+    def __init__(self, workers):
+        self.workers = workers
+
+    def running_count(self):
+        return len(self.workers)
+
+    def worker_port(self, run_id):
+        return self.workers[run_id].port
+
+
+class _WorkerStub:
+    def __init__(self, run_dir, port):
+        self.run_dir = run_dir
+        self.port = port
+
+
+def test_fleet_metrics_union_and_alert_counts(tmp_path):
+    from distributed_membership_tpu.fleet.daemon import FleetState
+    from distributed_membership_tpu.fleet.registry import Registry
+
+    class _H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = _WORKER_TEXT.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):      # quiet
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        root = str(tmp_path)
+        reg = Registry(root)
+        rec = reg.submit(_FLEET_CONF, run_id="w1")
+        reg.set_state(rec, "running", tick=30)
+        run_dir = rec.run_dir(root)
+        os.makedirs(run_dir)
+        # Two journaled watchdog alerts + fresh and dead-pid replica
+        # beacons in the worker's run dir.
+        log = RunLog(os.path.join(run_dir, "runlog.jsonl"))
+        log.event("alert", rule="tick_rate_collapse", severity="warn")
+        log.event("alert", rule="tick_rate_collapse", severity="warn")
+        log.event("alert", rule="detection_slo", severity="error")
+        assert write_beacon(
+            os.path.join(run_dir, "replica_0.json"),
+            {"pid": os.getpid(), "queries": 7, "qps": 1.5,
+             "snapshot_tick": 30, "engine_tick": 60, "tick_lag": 30})
+        assert write_beacon(
+            os.path.join(run_dir, "replica_1.json"),
+            {"pid": 2 ** 30, "queries": 1, "tick_lag": 99})
+
+        sched = _SchedStub({"w1": _WorkerStub(
+            run_dir, srv.server_address[1])})
+        state = FleetState(reg, sched, threading.Lock())
+        parsed = metricsbus.parse_text(state.metrics_text())
+
+        assert parsed[("dm_fleet_runs", (("state", "running"),))] == 1
+        assert parsed[("dm_fleet_workers_alive", ())] == 1
+        assert parsed[("dm_fleet_watchdog_alerts",
+                       (("rule", "detection_slo"),
+                        ("run_id", "w1")))] == 1
+        assert parsed[("dm_fleet_watchdog_alerts",
+                       (("rule", "tick_rate_collapse"),
+                        ("run_id", "w1")))] == 2
+        # The scraped worker surface gained run_id; its own labels won.
+        assert parsed[("dm_engine_tick", (("run_id", "w1"),))] == 42
+        assert parsed[("dm_queries_total",
+                       (("run_id", "other"),))] == 5
+        # Beacon-synthesized replica gauges; the dead-pid beacon is
+        # some previous life's leftovers and must not surface.
+        rep = (("replica", "0"), ("run_id", "w1"))
+        assert parsed[("dm_snapshot_lag_ticks", rep)] == 30
+        assert parsed[("dm_queries_total", rep)] == 7
+        assert not any(("replica", "1") in labels
+                       for _, labels in parsed)
+
+        code, summary = state.summary()
+        assert code == 200
+        (row,) = summary["runs"]
+        assert row["alerts"] == {"tick_rate_collapse": 2,
+                                 "detection_slo": 1}
+        assert summary["aggregate"]["alerts_total"] == 3
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Served end-to-end: /metrics mid-run + the span lifecycle across a
+# boundary stop, a torn spans tail, and --resume
+
+
+def _get_text(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return (resp.status, resp.getheader("Content-Type"),
+                resp.read().decode())
+    finally:
+        conn.close()
+
+
+def test_served_metrics_and_span_lifecycle_across_resume(
+        tmp_path, monkeypatch):
+    from test_service import (SEED, _EVENT, _gate_boundaries, _post,
+                              _served, _svc_params, _wait_health)
+    from distributed_membership_tpu.service.daemon import serve_run
+
+    gates = _gate_boundaries(monkeypatch)
+    p = _svc_params(tmp_path, "m")
+    out = tmp_path / "m"
+    out.mkdir()
+    span_path = str(out / spans.SPANS_NAME)
+    box = {}
+
+    def life1(port):
+        _wait_health(port, lambda h: h["snapshot_tick"] is not None)
+        code, reply = _post(port, "/v1/events", _EVENT)
+        assert code == 202 and reply["journaled"] is True
+        # Parked at boundary 0 with one accepted-not-yet-merged event:
+        # the engine gauges and the injection gauges are live.
+        code, ctype, text = _get_text(port, "/metrics")
+        assert code == 200
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+        m = metricsbus.parse_text(text)
+        assert m[("dm_engine_tick", ())] == 0
+        assert m[("dm_run_total_ticks", ())] == 120
+        assert m[("dm_pending_events", ())] == 1
+        assert m[("dm_queries_total", ())] >= 1
+        try:
+            gates[0].set()
+            _wait_health(port, lambda h: h["snapshot_tick"] == 30)
+            signal.raise_signal(signal.SIGTERM)
+        finally:
+            for g in gates.values():
+                g.set()
+
+    rc, _ = _served(lambda: serve_run(p, seed=SEED, out_dir=str(out)),
+                    str(out), life1)
+    assert rc == 0
+
+    eid = spans.event_id(_EVENT, 0)
+    assert eid == "crash@70#0"
+    first = spans.read_spans(span_path)
+    assert set(first[eid]) == {"accepted", "journaled", "compiled"}
+    assert first[eid]["accepted"]["tick"] == 0
+    assert first[eid]["compiled"]["tick"] == 30
+    # Tear the tail the way a SIGKILL mid-append would: the reader
+    # must skip it and the next stamp must repair onto a fresh line.
+    with open(span_path, "a") as fh:
+        fh.write('{"event_id": "crash@70#0", "stage": "rem')
+
+    def life2(port):
+        h = _wait_health(port, lambda h: h["status"] == "complete")
+        assert h["applied_events"] == 1
+        # The watchdog owns the observed stages; give its close/idle
+        # pass a beat rather than racing it.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            got = spans.read_spans(span_path).get(eid, {})
+            if {"first_detection", "removal"} <= set(got):
+                break
+            time.sleep(0.2)
+        _, _, text = _get_text(port, "/metrics")
+        box["metrics"] = metricsbus.parse_text(text)
+
+    pr = _svc_params(tmp_path, "m", resume=1)
+    rc, _ = _served(lambda: serve_run(pr, seed=SEED, out_dir=str(out)),
+                    str(out), life2)
+    assert rc == 0
+    assert box["metrics"][("dm_engine_tick", ())] == 120
+    assert box["metrics"][("dm_applied_events", ())] == 1
+
+    span_map = spans.read_spans(span_path)
+    stages = span_map[eid]
+    assert {"accepted", "journaled", "compiled", "first_detection",
+            "removal"} <= set(stages)
+    # Resume replayed the journal, re-derived the same id, and only
+    # stamped what was missing: the first life's ticks survive.
+    assert stages["accepted"]["tick"] == 0
+    assert stages["compiled"]["tick"] == 30
+    det = stages["first_detection"]
+    assert det["tick"] >= _EVENT["time"]
+    assert det["latency_ticks"] == det["tick"] - _EVENT["time"]
+    assert det["source"] == "removals"
+    assert stages["removal"]["tick"] >= det["tick"]
+
+    # The span stamps reconcile with the scenario oracle's verdicts.
+    with open(tmp_path / "m_tl" / "scenario.json") as fh:
+        oracle = json.load(fh)
+    series = read_timeline(str(tmp_path / "m_tl" / TIMELINE_NAME))
+    (row,) = spans.crosscheck(span_map, oracle, series=series,
+                              tremove=p.TREMOVE)
+    assert row["event_id"] == eid and row["fire_tick"] == 70
+    assert row["ordered"] is True
+    assert row["consistent"] is True, row
+
+
+# ---------------------------------------------------------------------------
+# The real merge, slow tier: 2-process N=2048 run, --merge, twin-exact
+
+
+_MERGE_CONF = (
+    "MAX_NNB: 2048\nSINGLE_FAILURE: 1\nDROP_MSG: 0\nMSG_DROP_PROB: 0\n"
+    "VIEW_SIZE: 16\nGOSSIP_LEN: 8\nPROBES: 2\nFANOUT: 3\nTFAIL: 16\n"
+    "TREMOVE: 40\nTOTAL_TIME: 40\nFAIL_TIME: 20\nJOIN_MODE: warm\n"
+    "EVENT_MODE: agg\nEXCHANGE: ring\nEXCHANGE_MODE: batched\n"
+    "BACKEND: tpu_hash_sharded\nTELEMETRY: scalars\n"
+    # Relative: each launcher child runs with cwd=p{i}, so every
+    # process flushes its own p{i}/timeline.jsonl shard.
+    "TELEMETRY_DIR: .\n")
+
+
+def _launch(conf_path, out_root, *extra_args, timeout=420):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # children build their own topology
+    for k in list(env):
+        if k.startswith("DM_DIST_"):
+            env.pop(k)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "multiproc_launch.py"),
+         str(conf_path), "--out-root", str(out_root),
+         "--timeout", str(timeout - 20), *extra_args],
+        env=env, cwd=REPO, timeout=timeout, capture_output=True,
+        text=True)
+
+
+@pytest.mark.slow
+def test_multiproc_merged_timeline_bit_identical(tmp_path):
+    """K=2 at N=2048: the launcher's ``--merge`` folds both shards
+    through the consistency cross-check, and the merged global series
+    is bit-identical to the single-process twin's — the acceptance
+    contract observability/merge.py documents."""
+    conf = tmp_path / "mp.conf"
+    conf.write_text(_MERGE_CONF)
+
+    r2 = _launch(conf, tmp_path / "mp2", "--procs", "2", "--merge")
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    assert "merged 2 shard(s)" in r2.stdout, r2.stdout
+
+    r1 = _launch(conf, tmp_path / "sp", "--procs", "1",
+                 "--devices-per-proc", "2")
+    assert r1.returncode == 0, (r1.stdout, r1.stderr)
+
+    merged = read_timeline(str(tmp_path / "mp2" / TIMELINE_NAME))
+    twin = read_timeline(str(tmp_path / "sp" / "p0" / TIMELINE_NAME))
+    assert merged["ticks"] == twin["ticks"] == 40
+    assert set(merged) == set(twin)
+    for field in sorted(set(merged) - {"t0", "ticks"}):
+        np.testing.assert_array_equal(
+            np.asarray(merged[field]), np.asarray(twin[field]),
+            err_msg=field)

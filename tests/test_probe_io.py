@@ -101,10 +101,17 @@ def test_pack_probe_bits_roundtrip():
     # Folded rows: P must divide 128 and EVENT_MODE agg (folded layout
     # support envelope — tpu_hash_folded.folded_supported); TREMOVE
     # re-sized for the wider P=2 probe cycle.
-    ("tpu_hash",
-     "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n"),
-    ("tpu_hash_sharded",
-     "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n"),
+    # The folded twins ride the slow tier (~6 s each): the zero-shape
+    # contract per layout is the same, and the folded layouts keep
+    # tier-1 probe coverage via test_folded/test_fused_folded.
+    pytest.param(
+        "tpu_hash",
+        "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n",
+        marks=pytest.mark.slow),
+    pytest.param(
+        "tpu_hash_sharded",
+        "PROBES: 2\nTFAIL: 16\nTREMOVE: 40\nEVENT_MODE: agg\nFOLDED: 1\n",
+        marks=pytest.mark.slow),
 ], ids=["hash", "sharded", "folded", "folded_sharded"])
 def test_probe_io_none_profiling_mode(backend, extra):
     """PROBE_IO: none (profiling-only) must not perturb the protocol —

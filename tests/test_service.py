@@ -347,7 +347,11 @@ def test_inject_sigterm_resume_bit_exact(tmp_path, monkeypatch):
 # Headless --resume of a served checkpoint replays the journal
 
 
+@pytest.mark.slow       # served + 2 headless lives (~16s); tier-1
 def test_headless_resume_replays_journal(tmp_path, monkeypatch):
+    # keeps journal-replay-on-resume via the span lifecycle test
+    # (tests/test_metrics_plane.py: SIGKILL + --resume re-derives the
+    # same event ids from the replayed journal).
     gates = _gate_boundaries(monkeypatch)
     p = _svc_params(tmp_path, "h")
     out = tmp_path / "h"

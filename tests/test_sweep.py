@@ -1,6 +1,7 @@
 """Phase-diagram sweep driver: one compile, correct per-cell records."""
 
 import numpy as np
+import pytest
 
 from distributed_membership_tpu.sweeps.phase import (
     SweepSpec, run_sweep, summarize)
@@ -29,9 +30,11 @@ def test_quick_grid():
             >= by_cell[(2, 0.0)]["false_removals_mean"])
 
 
-def test_dynamic_knobs_match_static_config():
+@pytest.mark.slow       # two full step compiles (~15s); tier-1 keeps
+def test_dynamic_knobs_match_static_config():  # the dynamic-knob path
     """A dynamic-knob run with (fanout=cfg.fanout, drop=0) must equal the
-    static step bit-for-bit: same keys, same draws, same trajectory."""
+    static step bit-for-bit: same keys, same draws, same trajectory.
+    (test_quick_grid keeps the dynamic-knob sweep path in tier-1.)"""
     import jax
     import jax.numpy as jnp
 
