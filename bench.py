@@ -52,7 +52,11 @@ bench:live:hash:exchange (keyed rung:p{P} under a DM_DIST_* multi-
 process run), BENCH_METRICS=1 re-times the SERVED leg under query load
 with vs. without a paced /metrics scraper process (BENCH_METRICS_HZ,
 default 10/s; best-of-BENCH_METRICS_REPS, default 5), interleaved;
-banked as bench:live:hash:metrics (observability/metricsbus.py).
+banked as bench:live:hash:metrics (observability/metricsbus.py),
+BENCH_RESHARD=1 prices elastic reshard-on-resume vs a same-shape resume
+(kill mid-flight, clone the checkpoint, reshard one clone to the
+transposed mesh — elastic/reshard.py); banked as
+bench:live:hash:elastic:reshard.
 
 Every live leg row is also banked into ``artifacts/perf_ledger.jsonl``
 (observability/perfdb.py) and checked against history; a regression
@@ -1365,6 +1369,16 @@ def leg_hash(n: int, ticks: int, pin: str | None,
                 100 * (walls["base"] - walls["batched"])
                 / max(walls["base"], 1e-9), 1),
         })
+    # BENCH_RESHARD=1: price elastic reshard-on-resume against a
+    # same-shape resume (elastic/reshard.py) — kill a checkpointed
+    # sharded run mid-flight, clone the durable checkpoint, resume one
+    # clone as-is and reshard the other to the transposed mesh first.
+    # Banked as bench:live:hash:reshard with the reshard knob lifted
+    # into the rung (perfdb), so the reshard arm trends apart from the
+    # plain-resume path.
+    if os.environ.get("BENCH_RESHARD", "0") not in ("", "0"):
+        ckpt_fields.update(_bench_reshard(geom_text, fused_keys,
+                                          shift_set, n, ticks))
     # BENCH_SCENARIO=1: price the scenario engine's in-scan tensor plan
     # (scenario/compile.py) at this leg's geometry, isolating the two
     # cost classes:
@@ -1638,6 +1652,85 @@ def _banked_displaces_live(banked: dict | None, live: dict) -> bool:
             == (live.get("shift_set") or 0))
 
 
+def _bench_reshard(geom_text: str, fused_keys: str, shift_set: str,
+                   n: int, ticks: int) -> dict:
+    """BENCH_RESHARD=1: price elastic reshard-on-resume
+    (elastic/reshard.py) against a same-shape resume at this leg's
+    geometry.  One checkpointed SHARDED run is killed mid-flight (the
+    injected crash the chaos drills use), its durable checkpoint cloned
+    into two arms: a plain resume on the same mesh shape, and a reshard
+    to the transposed shape followed by a resume there.  The reshard
+    op's own wall (codec round-trip + host redistribute + manifest
+    fan-out) is the banked number; both resume walls ride along so the
+    honest migration overhead — reshard + recompile on the new mesh —
+    reads directly off the row."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from distributed_membership_tpu.backends import get_backend
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.elastic.reshard import reshard
+    from distributed_membership_tpu.runtime.checkpoint import CRASH_ENV
+
+    devs = jax.device_count()
+    from_shape = str(devs)
+    to_shape = (f"{devs // 2}x2" if devs >= 2 and devs % 2 == 0
+                else f"{devs}x1")
+    every = max(ticks // 4, 1)
+
+    def _params(shape: str, ckdir: str):
+        return Params.from_text(
+            geom_text + fused_keys
+            + f"SHIFT_SET: {shift_set}\nEXCHANGE: ring\n"
+            f"MESH_SHAPE: {shape}\nBACKEND: tpu_hash_sharded\n"
+            f"CHECKPOINT_EVERY: {every}\nCHECKPOINT_DIR: {ckdir}\n"
+            "RESUME: 1\n")
+
+    run = get_backend("tpu_hash_sharded")
+    with tempfile.TemporaryDirectory() as td:
+        seed_ck = os.path.join(td, "seed_ck")
+        os.environ[CRASH_ENV] = str(ticks // 2)
+        try:
+            try:
+                run(_params(from_shape, seed_ck), seed=0)
+                raise SystemExit("BENCH_RESHARD: injected crash never "
+                                 f"fired at --ticks {ticks}")
+            except RuntimeError:
+                pass
+        finally:
+            os.environ.pop(CRASH_ENV, None)
+        same_ck = os.path.join(td, "same_ck")
+        moved_ck = os.path.join(td, "moved_ck")
+        shutil.copytree(seed_ck, same_ck)
+        shutil.copytree(seed_ck, moved_ck)
+        t0 = time.perf_counter()
+        run(_params(from_shape, same_ck), seed=0)
+        same_wall = time.perf_counter() - t0
+        stats = reshard([moved_ck], [moved_ck], to_mesh_shape=to_shape)
+        t0 = time.perf_counter()
+        run(_params(to_shape, moved_ck), seed=0)
+        moved_wall = time.perf_counter() - t0
+    return {
+        "reshard_devices": devs,
+        "reshard_from_shape": from_shape,
+        "reshard_to_shape": to_shape,
+        "reshard_tick": stats["tick"],
+        "reshard_seconds": round(stats["wall_seconds"], 3),
+        "reshard_codec_seconds": round(stats["codec_seconds"], 3),
+        "reshard_redistribute_seconds": round(
+            stats["redistribute_seconds"], 3),
+        "reshard_carry_bytes_full": stats["carry_bytes_full"],
+        "reshard_carry_bytes_packed": stats["carry_bytes_packed"],
+        "resume_same_shape_wall_seconds": round(same_wall, 3),
+        "resume_reshard_wall_seconds": round(moved_wall, 3),
+        "reshard_resume_overhead_pct": round(
+            100 * (moved_wall + stats["wall_seconds"] - same_wall)
+            / max(same_wall, 1e-9), 1),
+    }
+
+
 def _ledger_bank(leg: str, row: dict) -> None:
     """Bank a live leg row into artifacts/perf_ledger.jsonl and warn on
     regressions vs banked history (observability/perfdb.py).  The ledger
@@ -1749,6 +1842,30 @@ def _ledger_bank(leg: str, row: dict) -> None:
                 backend="tpu_hash_sharded",
                 platform=row.get("platform"),
                 knobs=x_knobs, source="bench.py"))
+        if row.get("reshard_seconds") is not None:
+            # The BENCH_RESHARD companion row: the reshard operation's
+            # own wall (lower is better), keyed rung:...:reshard via
+            # the lifted knob so a same-shape resume trend never masks
+            # a reshard-path regression.  Resume walls ride as knobs.
+            rows.append(perfdb.make_row(
+                f"bench:live:{leg}:elastic",
+                metric="reshard_wall_seconds",
+                value=row["reshard_seconds"], higher_is_better=False,
+                n=row.get("n"), s=row.get("view_size"),
+                backend="tpu_hash_sharded",
+                platform=row.get("platform"),
+                knobs={"reshard": 1,
+                       "devices": row.get("reshard_devices"),
+                       "from_shape": row.get("reshard_from_shape"),
+                       "to_shape": row.get("reshard_to_shape"),
+                       "carry_bytes_full":
+                       row.get("reshard_carry_bytes_full"),
+                       "resume_same_wall_seconds":
+                       row.get("resume_same_shape_wall_seconds"),
+                       "resume_reshard_wall_seconds":
+                       row.get("resume_reshard_wall_seconds"),
+                       "ticks": row.get("ticks")},
+                source="bench.py"))
         if row.get("mega_ticks"):
             # The BENCH_MEGA companion row: T-tick blocked scan vs the
             # per-tick chunked program (positive = residency wins).

@@ -113,13 +113,80 @@ def _grade(report: Optional[dict]) -> dict:
 
 
 def _run_inproc(conf_text: str, scn_path: str, seed: int) -> dict:
-    """One run through the jitted backend; -> the oracle report."""
+    """One run through the jitted backend; -> the oracle report.
+
+    Schedules carrying harness-level ``migrate`` events (chaos/fuzz.py,
+    opt-in mix) take the elastic path: checkpointed, killed at each
+    migrate tick, resharded, resumed — the oracle then grades the same
+    full trajectory a migration-free run produces, because chunked
+    resume is byte-exact repo-wide."""
     from distributed_membership_tpu.backends import get_backend
     from distributed_membership_tpu.config import Params
     from distributed_membership_tpu.sweeps.fleet_submit import override_conf
+    try:
+        with open(scn_path) as fh:
+            sch = json.load(fh)
+    except (OSError, ValueError):
+        sch = {}
+    migrations = sorted({int(e["time"]) for e in sch.get("events", ())
+                         if e.get("kind") == "migrate"})
+    if migrations:
+        return _run_inproc_migrating(conf_text, sch, scn_path, seed,
+                                     migrations)
     params = Params.from_text(
         override_conf(conf_text, "SCENARIO", scn_path))
     r = get_backend(params.BACKEND)(params, seed=seed)
+    return r.extra["scenario_report"]
+
+
+def _run_inproc_migrating(conf_text: str, sch: dict, scn_path: str,
+                          seed: int, migrations) -> dict:
+    """Execute a schedule's migrate events for real: run chunked, inject
+    the kill at each migrate tick (the same fault a worker death
+    leaves), reshard the durable carry in place (same geometry — the
+    provenance chain and codec round-trip are what this exercises), and
+    resume.  The engine never sees ``migrate``: it gets a stripped
+    scenario side file."""
+    from distributed_membership_tpu.backends import get_backend
+    from distributed_membership_tpu.config import Params
+    from distributed_membership_tpu.elastic.reshard import reshard
+    from distributed_membership_tpu.runtime.checkpoint import (
+        CRASH_ENV, load_manifest)
+    from distributed_membership_tpu.sweeps.fleet_submit import override_conf
+    engine_path = scn_path + ".engine.json"
+    engine = dict(sch)
+    engine["events"] = [e for e in sch.get("events", ())
+                        if e.get("kind") != "migrate"]
+    with open(engine_path, "w") as fh:
+        fh.write(dump_schedule(engine))
+    ck = scn_path + ".ckpt"
+    conf = override_conf(conf_text, "SCENARIO", engine_path)
+    conf = override_conf(conf, "CHECKPOINT_EVERY", 10)
+    conf = override_conf(conf, "CHECKPOINT_DIR", ck)
+    conf = override_conf(conf, "RESUME", 1)
+    params = Params.from_text(conf)
+    run = get_backend(params.BACKEND)
+    prev = os.environ.get(CRASH_ENV)
+    try:
+        for t in migrations:
+            os.environ[CRASH_ENV] = str(t)
+            try:
+                r = run(params, seed=seed)
+                return r.extra["scenario_report"]   # tick past the end
+            except RuntimeError as e:
+                if "injected crash" not in str(e):
+                    raise
+            if load_manifest(ck) is not None:
+                # Same-geometry reshard: codec round-trip + provenance
+                # stamp without changing where the run resumes.
+                reshard([ck], [ck])
+        os.environ.pop(CRASH_ENV, None)
+        r = run(params, seed=seed)
+    finally:
+        if prev is None:
+            os.environ.pop(CRASH_ENV, None)
+        else:
+            os.environ[CRASH_ENV] = prev
     return r.extra["scenario_report"]
 
 
@@ -247,9 +314,24 @@ def _run_fleet(journal: Journal, spec: CampaignSpec, schedules, seeds,
     dir's oracle report once the grid is terminal."""
     from distributed_membership_tpu.sweeps.fleet_submit import (
         submit_grid, wait_grid)
-    subs = [{"conf": conf_text, "run_id": sch["name"], "seed": seed,
-             "scenario": {"name": sch["name"], "events": sch["events"]}}
-            for sch, seed in zip(schedules, seeds)]
+    # Harness-level migrate events are inproc-only (the controller's
+    # own FLEET_MIGRATE_* machinery owns migration in fleet mode);
+    # strip them and say so in the journal rather than silently.
+    stripped = 0
+    subs = []
+    for sch, seed in zip(schedules, seeds):
+        events = [e for e in sch["events"] if e.get("kind") != "migrate"]
+        stripped += len(sch["events"]) - len(events)
+        subs.append({"conf": conf_text, "run_id": sch["name"],
+                     "seed": seed,
+                     "scenario": {"name": sch["name"], "events": events}})
+    if stripped:
+        journal.append({"kind": "note",
+                        "note": f"fleet mode: stripped {stripped} "
+                                "harness-level migrate event(s); use "
+                                "inproc mode or FLEET_MIGRATE_ON to "
+                                "exercise migration"})
+        say(f"stripped {stripped} migrate event(s) (fleet mode)")
     submit_grid(port, subs)
     say(f"submitted {len(subs)} runs to fleet :{port}")
     rows = wait_grid(port, [s["run_id"] for s in subs])

@@ -234,6 +234,17 @@ def fuzz_schedule(spec: CampaignSpec, index: int) -> dict:
         events.append({"kind": "leave", "time": rng.randrange(lo_t, hi_t),
                        "range": [r[0], r[1]]})
 
+    # -- migrations (harness-level; opt-in via --mix, NOT in
+    # DEFAULT_MIX — adding it there would shift every pinned campaign
+    # digest).  Not a scenario-engine kind: the campaign runner
+    # executes a migrate by killing the checkpointed run at this tick,
+    # resharding the durable carry, and resuming (chaos/campaign.py).
+    # Byte-exact chunked resume keeps the graded trajectory identical,
+    # so the oracle verdict is unchanged by WHERE the migrations land.
+    for _ in range(counts.pop("migrate", 0)):
+        events.append({"kind": "migrate",
+                       "time": rng.randrange(lo_t, hi_t)})
+
     # -- partitions (2-group, non-overlapping in time) ------------------
     # Segmented placement: partition j draws inside its own slice of
     # the active window, so any count fits without overlap and none is

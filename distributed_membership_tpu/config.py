@@ -382,6 +382,17 @@ class Params:
     # deterministically); 0 = shut workers down on completion so the
     # process table holds only ticking runs.
     FLEET_LINGER: int = 0
+    # Automatic failover/migration policy (elastic/migrate.py): comma
+    # list of triggers the scheduler acts on — 'death' (worker process
+    # died with a durable checkpoint), 'alerts' (watchdog alert rules
+    # firing in the run's runlog), 'stale-beacon' (progress beacon
+    # stopped advancing) — '' = off (manual POST /v1/runs/<id>/migrate
+    # still works).  Controller key, trajectory-inert.
+    FLEET_MIGRATE_ON: str = ""
+    # Per-run cap on AUTOMATIC migrations (manual drains don't count):
+    # a run that keeps dying lands in a terminal failed state instead of
+    # thrashing the fleet forever.  0 = manual migration only.
+    FLEET_MIGRATE_MAX: int = 2
     # Mid-run SLO watchdog (observability/watchdog.py), served runs
     # only: a daemon thread evaluates degradation rules (tick-rate
     # collapse, publisher backlog growth, replica staleness, live
@@ -615,6 +626,19 @@ class Params:
         if self.FLEET_LINGER not in (0, 1):
             raise ValueError(
                 f"FLEET_LINGER must be 0 or 1, got {self.FLEET_LINGER!r}")
+        if self.FLEET_MIGRATE_ON:
+            bad = [t for t in
+                   (p.strip() for p in self.FLEET_MIGRATE_ON.split(","))
+                   if t not in ("death", "alerts", "stale-beacon")]
+            if bad:
+                raise ValueError(
+                    f"FLEET_MIGRATE_ON must be a comma list drawn from "
+                    f"'death', 'alerts', 'stale-beacon', got {bad!r} in "
+                    f"{self.FLEET_MIGRATE_ON!r}")
+        if self.FLEET_MIGRATE_MAX < 0:
+            raise ValueError(
+                f"FLEET_MIGRATE_MAX must be >= 0 automatic migrations "
+                f"per run (0 = manual only), got {self.FLEET_MIGRATE_MAX!r}")
         if self.WATCHDOG not in (0, 1):
             raise ValueError(
                 f"WATCHDOG must be 0 or 1, got {self.WATCHDOG!r}")

@@ -1,0 +1,14 @@
+"""Elastic mesh: topology is a runtime variable, not a config constant.
+
+Three legs (see ROADMAP "Elastic mesh"):
+
+* ``elastic/reshard.py`` — reshard-on-resume: rewrite a durable
+  checkpoint so ``--resume`` can continue on a different ``MESH_SHAPE``
+  and process count, with the carry redistributed host-side and the
+  manifest stamped with chained reshard provenance.
+* ``elastic/migrate.py`` — the fleet migration policy: which PR-18
+  health signals (worker death, watchdog alerts, stale beacons) move a
+  run, and the journaled ``migrating`` → ``requeued`` transition.
+* ``fleet/placement.py`` — the capacity model the scheduler consults so
+  migration targets are chosen, not guessed.
+"""

@@ -47,7 +47,7 @@ from distributed_membership_tpu.service import api
 
 FLEET_JSON = "fleet.json"
 _RUNS_PREFIX = "/v1/runs"
-_VERBS = ("pause", "resume", "kill")
+_VERBS = ("pause", "resume", "kill", "migrate")
 # A worker scrape must never stall the fleet's own /metrics reply
 # behind a wedged daemon: connection-level failures simply drop that
 # worker's samples from this scrape.
@@ -200,6 +200,32 @@ class FleetState:
                                         killing=False)
                 self.scheduler.wake()
                 return 202, {"run_id": run_id, "state": "queued"}
+            if verb == "migrate":
+                # Operator drain (elastic/migrate.py): a RUNNING run is
+                # SIGTERMed to park at a durable boundary and the reap
+                # path journals migrating -> requeued; an already-parked
+                # run (checkpointed/failed/killed) requeues immediately.
+                if rec.state == "running":
+                    if rec.mode == "headless":
+                        return 409, {"error": "run has no chunked "
+                                              "driver (mode headless) "
+                                              "— nothing durable to "
+                                              "migrate"}
+                    if not self.scheduler.migrate(rec):
+                        return 409, {"error": "worker is not "
+                                              "signallable"}
+                    return 202, {"run_id": run_id, "migrating": True}
+                if rec.state in ("checkpointed", "failed", "killed"):
+                    from distributed_membership_tpu.elastic.migrate \
+                        import migrate_record
+                    detail = migrate_record(self.registry, rec,
+                                            "manual")
+                    self.scheduler.wake()
+                    return 202, {"run_id": run_id, "state": rec.state,
+                                 **detail}
+                return 409, {"error": f"run is {rec.state}; only "
+                                      "running/checkpointed/failed/"
+                                      "killed runs can migrate"}
             # kill
             if rec.state == "queued":
                 self.registry.set_state(rec, "killed")
@@ -236,6 +262,9 @@ class FleetState:
             row = {"run_id": rec.run_id, "state": rec.state,
                    "tick": rec.tick, "total": rec.total,
                    "live": None, "slo": None, "alerts": {}}
+            if rec.migrations or rec.last_trigger:
+                row["migrations"] = rec.migrations
+                row["last_trigger"] = rec.last_trigger
             run_dir = rec.run_dir(root)
             row["alerts"] = _alert_counts(run_dir)
             tl = os.path.join(run_dir, "timeline.jsonl")
@@ -565,7 +594,8 @@ def port_in_use_hint(err, root: str) -> str:
 
 
 def fleet_main(root: str, port: int = 0, max_concurrency: int = 2,
-               linger: bool = False) -> int:
+               linger: bool = False, migrate_on: str = "",
+               migrate_max: int = 2) -> int:
     """Run the controller until shutdown; -> exit code.
 
     Startup IS crash recovery: there is no separate repair path.  The
@@ -573,6 +603,10 @@ def fleet_main(root: str, port: int = 0, max_concurrency: int = 2,
     controller (cleanly stopped or SIGKILLed mid-sweep) left behind,
     then the scheduler simply dispatches the queue.
     """
+    from distributed_membership_tpu.elastic.migrate import MigratePolicy
+    # Policy is always built (manual POST /migrate works regardless);
+    # migrate_on decides which health signals trigger AUTOMATIC moves.
+    policy = MigratePolicy.from_conf(migrate_on, migrate_max)
     os.makedirs(root, exist_ok=True)
     registry = Registry(root)
     orphans = reap_orphans(registry.journal.read(), root)
@@ -586,7 +620,7 @@ def fleet_main(root: str, port: int = 0, max_concurrency: int = 2,
     recovered = registry.recover()
     lock = threading.Lock()
     scheduler = Scheduler(registry, max_concurrency, lock,
-                          linger=linger)
+                          linger=linger, policy=policy)
     state = FleetState(registry, scheduler, lock, linger=linger)
     try:
         server = make_fleet_server(state, port)
@@ -654,7 +688,17 @@ def fleet_conf(conf_path: Optional[str], port: Optional[int] = None,
         print("fleet: FLEET_MAX_CONCURRENCY must be >= 1 and "
               "FLEET_LINGER 0 or 1", file=sys.stderr)
         return 2
+    try:
+        from distributed_membership_tpu.elastic.migrate import (
+            MigratePolicy)
+        MigratePolicy.from_conf(params.FLEET_MIGRATE_ON,
+                                params.FLEET_MIGRATE_MAX)
+    except ValueError as e:
+        print(f"fleet: {e}", file=sys.stderr)
+        return 2
     root = params.FLEET_DIR or out_dir
     return fleet_main(root, port=params.FLEET_PORT,
                       max_concurrency=params.FLEET_MAX_CONCURRENCY,
-                      linger=bool(params.FLEET_LINGER))
+                      linger=bool(params.FLEET_LINGER),
+                      migrate_on=params.FLEET_MIGRATE_ON,
+                      migrate_max=params.FLEET_MIGRATE_MAX)

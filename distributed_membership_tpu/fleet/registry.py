@@ -33,7 +33,7 @@ from distributed_membership_tpu.config import Params
 
 JOURNAL_NAME = "fleet_runs.jsonl"
 RUN_STATES = ("queued", "running", "checkpointed", "done", "failed",
-              "killed")
+              "killed", "migrating", "requeued")
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
 # Forced on chunkable workers whose conf leaves CHECKPOINT_EVERY at 0:
@@ -71,6 +71,12 @@ class RunRecord:
     pausing: bool = False
     killing: bool = False
     adopted: bool = False      # recovered from disk, not run by us
+    # Elastic-mesh migration (elastic/migrate.py): automatic-migration
+    # count (the FLEET_MIGRATE_MAX cap; manual drains don't count),
+    # last trigger rule, and the operator/policy drain flag.
+    migrations: int = 0
+    last_trigger: str = ""
+    migrate_requested: bool = False
 
     def run_dir(self, root: str) -> str:
         return os.path.join(root, self.run_id)
@@ -105,6 +111,10 @@ class RunRecord:
             out["killing"] = True
         if self.adopted:
             out["adopted"] = True
+        if self.migrations:
+            out["migrations"] = self.migrations
+        if self.last_trigger:
+            out["last_trigger"] = self.last_trigger
         return out
 
 
@@ -261,17 +271,40 @@ class Registry:
         rec.state = state
         for k, v in detail.items():
             setattr(rec, k, v)
+        if state == "migrating":
+            # Counted here (and in replay) so the FLEET_MIGRATE_MAX cap
+            # survives a controller crash; manual drains are exempt.
+            rec.last_trigger = str(detail.get("trigger", ""))
+            if rec.last_trigger != "manual":
+                rec.migrations += 1
         row = {"kind": "state", "run_id": rec.run_id, "state": state,
                "ts": time.time()}
-        for k in ("pid", "port", "exit_code", "error", "tick"):
+        for k in ("pid", "port", "exit_code", "error", "tick",
+                  "trigger", "from_tick", "resume_tick"):
             v = detail.get(k)
             if v not in (None, ""):
                 row[k] = v
         self.journal.append(row)
 
+    def update_conf(self, rec: RunRecord, conf_text: str) -> None:
+        """Journal + apply a conf rewrite (elastic migration retarget:
+        placement pinned the run to a slice with a different mesh
+        shape).  Validated first; journaled fsync-before-apply so a
+        recovered controller rebuilds the SAME conf the resharded
+        checkpoint expects."""
+        params = Params().parse(conf_text, validate=False)
+        params.validate()
+        self.journal.append({"kind": "conf_update", "run_id": rec.run_id,
+                             "conf": conf_text, "ts": time.time()})
+        rec.conf_text = conf_text
+        rec.backend = params.BACKEND
+        rec.total = params.TOTAL_TIME
+        rec.mode = plan_mode(params)
+
     def queued(self, key=None) -> List[RunRecord]:
         """Queued runs in dispatch order: priority, then submit FIFO."""
-        q = [r for r in self.runs.values() if r.state == "queued"]
+        q = [r for r in self.runs.values()
+             if r.state in ("queued", "requeued")]
         q.sort(key=key or (lambda r: (r.priority, r.seq)))
         return q
 
@@ -335,11 +368,30 @@ class Registry:
                 rec.tick = int(row.get("tick", rec.tick))
                 rec.exit_code = row.get("exit_code", rec.exit_code)
                 rec.error = row.get("error", rec.error)
+                if row["state"] == "migrating":
+                    rec.last_trigger = str(row.get("trigger", ""))
+                    if rec.last_trigger != "manual":
+                        rec.migrations += 1
+            elif kind == "conf_update":
+                rec = self.runs.get(row.get("run_id"))
+                if rec is None or not row.get("conf"):
+                    continue
+                try:
+                    params = Params().parse(row["conf"], validate=False)
+                    params.validate()
+                except ValueError:
+                    continue
+                rec.conf_text = row["conf"]
+                rec.backend = params.BACKEND
+                rec.total = params.TOTAL_TIME
+                rec.mode = plan_mode(params)
         summary = {"adopted": 0, "requeued": 0, "kept": 0}
         for rec in self.runs.values():
             rec.pid = rec.port = None     # no worker survives us
             rec.pausing = rec.killing = False
-            if rec.state in ("running", "queued"):
+            rec.migrate_requested = False
+            if rec.state in ("running", "queued", "migrating",
+                             "requeued"):
                 probed = self._probe_disk(rec)
                 if probed == "done":
                     rec.adopted = True

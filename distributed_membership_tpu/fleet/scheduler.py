@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -162,6 +163,8 @@ class _Worker:
         self.lingering = False       # run done, still serving
         self.shutdown_sent = False
         self.next_health = 0.0
+        self.started_wall = time.time()   # alert rows older than this
+        self.migrate_trigger = ""         # are a previous incarnation's
 
     def state_path(self) -> str:
         return os.path.join(self.run_dir, "run_state.json")
@@ -197,6 +200,15 @@ class _Worker:
             return text[-limit:]
         except OSError:
             return ""
+
+
+def _override_mesh(conf_text: str, shape: str) -> str:
+    """conf text with MESH_SHAPE pinned to ``shape`` (placement
+    retarget) — any existing MESH_SHAPE line is dropped first."""
+    lines = [ln for ln in conf_text.splitlines()
+             if not re.match(r"\s*MESH_SHAPE\s*:", ln)]
+    lines.append(f"MESH_SHAPE: {shape}")
+    return "\n".join(lines) + "\n"
 
 
 def worker_argv(rec: RunRecord, root: str) -> list:
@@ -235,11 +247,18 @@ class Scheduler:
     never needs its own."""
 
     def __init__(self, registry: Registry, max_concurrency: int,
-                 lock: threading.Lock, linger: bool = False):
+                 lock: threading.Lock, linger: bool = False,
+                 policy=None, placement=None):
         self.registry = registry
         self.max_concurrency = int(max_concurrency)
         self.lock = lock
         self.linger = bool(linger)
+        # Elastic mesh: the migration policy (elastic/migrate.py
+        # MigratePolicy, None = manual /migrate only) and the capacity
+        # model (fleet/placement.py HostCapacity, None = unconstrained —
+        # the pre-elastic behavior every existing fleet keeps).
+        self.policy = policy
+        self.placement = placement
         self.workers: Dict[str, _Worker] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -326,6 +345,22 @@ class Scheduler:
             return False
         return True
 
+    def migrate(self, rec: RunRecord) -> bool:
+        """Operator drain (POST /v1/runs/<id>/migrate on a RUNNING
+        run): SIGTERM so the chunked driver parks at the next durable
+        boundary, then the reap path journals migrating -> requeued."""
+        w = self.workers.get(rec.run_id)
+        if (w is None or w.proc.poll() is not None or w.lingering
+                or rec.mode == "headless"):
+            return False
+        rec.migrate_requested = True
+        w.migrate_trigger = "manual"
+        try:
+            w.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return False
+        return True
+
     def worker_port(self, run_id: str) -> Optional[int]:
         w = self.workers.get(run_id)
         if w is None or w.proc.poll() is not None:
@@ -378,8 +413,44 @@ class Scheduler:
         for rec in self.registry.queued():
             if free <= 0:
                 break
+            if self.placement is not None and not self._place(rec):
+                continue         # no capacity: stays queued, not lost
             self._spawn(rec)
             free -= 1
+
+    def _place(self, rec: RunRecord) -> bool:
+        """Consult the capacity model; retarget the run's mesh shape
+        when the granted slice prescribes a different one (the
+        'resharded if needed' leg: the durable checkpoint is rewritten
+        by elastic/reshard.py and the conf change is journaled)."""
+        from distributed_membership_tpu.elastic.reshard import (
+            ReshardError, mesh_size, reshard)
+        from distributed_membership_tpu.fleet.placement import (
+            PlacementError)
+        p = Params().parse(rec.conf_text, validate=False)
+        sharded = p.BACKEND.endswith("_sharded")
+        try:
+            granted = self.placement.place(
+                rec.run_id, sharded=sharded,
+                devices=mesh_size(p.MESH_SHAPE, default=1))
+        except PlacementError as e:
+            rec.error = str(e)   # visible in GET /v1/runs while queued
+            return False
+        if (sharded and granted.mesh_shape
+                and granted.mesh_shape != p.MESH_SHAPE):
+            try:
+                ck = rec.ckpt_dir(self.registry.root)
+                if os.path.exists(os.path.join(ck, "MANIFEST.json")):
+                    reshard([ck], [ck],
+                            to_mesh_shape=granted.mesh_shape)
+                self.registry.update_conf(
+                    rec, _override_mesh(rec.conf_text,
+                                        granted.mesh_shape))
+            except (ReshardError, ValueError) as e:
+                self.placement.release(rec.run_id)
+                rec.error = f"reshard to {granted.mesh_shape!r}: {e}"
+                return False
+        return True
 
     def _poll(self) -> None:
         now = time.monotonic()
@@ -389,6 +460,7 @@ class Scheduler:
             st = read_run_state(w.state_path())
             if st is not None:
                 w.rec.tick = max(w.rec.tick, int(st.get("tick", 0)))
+            self._check_sick(w, st)
             if w.rec.mode != "serve" or now < w.next_health:
                 continue
             w.next_health = now + HEALTH_EVERY_SECONDS
@@ -411,6 +483,31 @@ class Scheduler:
                     w.shutdown_sent = True
                     _http(port, "POST", "/v1/admin/shutdown")
 
+    def _check_sick(self, w: _Worker, beacon: Optional[dict]) -> None:
+        """Watchdog-alert / stale-beacon migration triggers (PR 18
+        signals): a sick worker is drained — SIGTERM when it can still
+        checkpoint (alerts), SIGKILL when it is wedged (stale beacon;
+        the last durable boundary is adopted) — and the reap path
+        journals the migration."""
+        rec = w.rec
+        if (self.policy is None or rec.migrate_requested
+                or rec.mode == "headless"
+                or rec.migrations >= self.policy.max_migrations):
+            return
+        trigger = self.policy.sick_trigger(
+            run_dir=w.run_dir, beacon=beacon, total=rec.total,
+            started_wall=w.started_wall)
+        if trigger is None:
+            return
+        rec.migrate_requested = True
+        w.migrate_trigger = trigger
+        try:
+            w.proc.send_signal(signal.SIGKILL
+                               if trigger == "stale-beacon"
+                               else signal.SIGTERM)
+        except OSError:
+            pass
+
     def _reap(self) -> None:
         for run_id in list(self.workers):
             w = self.workers[run_id]
@@ -424,13 +521,37 @@ class Scheduler:
             del self.workers[run_id]
             rec = w.rec
             rec.pid = rec.port = None
+            if self.placement is not None:
+                self.placement.release(run_id)
             if w.lingering:
                 continue             # already journaled done
-            self.registry.set_state(rec, self._classify(rec, rc),
+            seen_tick = rec.tick     # beacon's last word before probing
+            was_asked = rec.pausing or rec.killing
+            state = self._classify(rec, rc)
+            self.registry.set_state(rec, state,
                                     exit_code=rc, tick=rec.tick,
                                     pausing=False, killing=False,
                                     error=("" if rc == 0
                                            else w.log_tail()))
+            trigger = w.migrate_trigger
+            if (not trigger and not was_asked and self.policy is not None
+                    and self.policy.on_death):
+                trigger = "death"
+            if trigger and state in ("checkpointed", "failed"):
+                self._migrate_now(rec, trigger, from_tick=seen_tick)
+
+    def _migrate_now(self, rec: RunRecord, trigger: str,
+                     from_tick: int) -> None:
+        """Journal the ``migrating`` -> ``requeued`` transition (both
+        fsync-before-ACK via the registry journal).  The relaunch path
+        (placement consult in ``_launch``) picks the target."""
+        from distributed_membership_tpu.elastic.migrate import (
+            migrate_record)
+        rec.migrate_requested = False
+        if (trigger != "manual" and self.policy is not None
+                and rec.migrations >= self.policy.max_migrations):
+            return               # cap reached: terminal state stands
+        migrate_record(self.registry, rec, trigger, from_tick=from_tick)
 
     def _classify(self, rec: RunRecord, rc: int) -> str:
         """Exit code + on-disk reality -> registry state."""
@@ -447,8 +568,14 @@ class Scheduler:
             # goes back to the queue from scratch.
             return ("checkpointed" if rc == 0 and rec.tick > 0
                     else "queued")
-        if rc == 0 and rec.mode != "headless" and rec.tick > 0:
-            # Unrequested-but-graceful exit (operator SIGTERMed the
-            # worker directly): the checkpoint is durable, keep it.
+        if rec.mode != "headless" and rec.tick > 0:
+            # Graceful-but-unrequested exit (operator SIGTERMed the
+            # worker directly), OR a crash that still left a COMPLETE
+            # durable boundary — the disk probe above refreshed
+            # rec.tick from the manifest, which only ever names fully
+            # written snapshots (atomic rename).  A worker that died
+            # DURING a checkpoint write therefore lands here too, and
+            # failover resumes from the last boundary instead of
+            # restarting from scratch.
             return "checkpointed"
         return "failed"

@@ -81,6 +81,13 @@ def make_row(rung: str, *, metric: str, value: float,
     # separately in the regression report.
     if knobs.get("service_workers"):
         rung = f"{rung}:w{int(knobs['service_workers'])}"
+    # Elastic-resume rows key per RESUME KIND: a truthy
+    # knobs["reshard"] lifts the reshard arm into the rung
+    # (rung:reshard) — a same-shape resume trend must never mask a
+    # reshard-path regression (the host-side redistribute + codec
+    # round-trip exist only on that arm).
+    if knobs.get("reshard"):
+        rung = f"{rung}:reshard"
     digest = knobs_digest(knobs)
     key = "|".join([rung, str(n), str(s), str(backend), str(platform),
                     metric, digest])

@@ -27,7 +27,8 @@ def apply_overrides(params: Params, backend: str | None = None,
                     resume: bool | None = None,
                     telemetry: str | None = None,
                     telemetry_dir: str | None = None,
-                    scenario: str | None = None) -> Params:
+                    scenario: str | None = None,
+                    mesh_shape: str | None = None) -> Params:
     """Merge CLI overrides into an un-validated Params (shared by
     ``run_conf`` and the service daemon's ``serve_conf``)."""
     if backend is not None:
@@ -51,6 +52,13 @@ def apply_overrides(params: Params, backend: str | None = None,
     # conf's SCENARIO key, same precedence as every knob above.
     if scenario is not None:
         params.SCENARIO = scenario
+    # Elastic mesh (elastic/reshard.py): --mesh-shape retargets a
+    # sharded run's device mesh.  MESH_SHAPE is part of the checkpoint
+    # identity, so resuming onto a new shape requires an explicit
+    # reshard first — this override is how the resharded run (or the
+    # multiproc launcher's children) states the new geometry.
+    if mesh_shape is not None:
+        params.MESH_SHAPE = mesh_shape
     return params
 
 
@@ -61,7 +69,8 @@ def run_conf(conf_path: str, backend: str | None = None,
              resume: bool | None = None,
              telemetry: str | None = None,
              telemetry_dir: str | None = None,
-             scenario: str | None = None) -> RunResult:
+             scenario: str | None = None,
+             mesh_shape: str | None = None) -> RunResult:
     # Validation runs AFTER the CLI overrides merge: cross-field rules
     # (e.g. RNG_MODE hoisted requiring CHECKPOINT_EVERY > 0) must see the
     # effective config, not the conf file alone.
@@ -70,7 +79,7 @@ def run_conf(conf_path: str, backend: str | None = None,
                     checkpoint_every=checkpoint_every,
                     checkpoint_dir=checkpoint_dir, resume=resume,
                     telemetry=telemetry, telemetry_dir=telemetry_dir,
-                    scenario=scenario)
+                    scenario=scenario, mesh_shape=mesh_shape)
     params.validate()
     log = EventLog(out_dir)
     result = None
@@ -226,6 +235,13 @@ def main(argv=None) -> int:
                     help="TELEMETRY_DIR conf key: directory for "
                          "timeline.jsonl / runlog.jsonl / summary.json "
                          "(render with scripts/run_report.py)")
+    ap.add_argument("--mesh-shape", default=None, metavar="SHAPE",
+                    help="MESH_SHAPE conf key ('D', 'OxI' or 'SxOxI'; "
+                         "tpu_hash_sharded only).  Resuming onto a "
+                         "shape different from the checkpoint's "
+                         "requires an explicit reshard first "
+                         "(python -m distributed_membership_tpu."
+                         "elastic.reshard)")
     ap.add_argument("--scenario", default=None, metavar="FILE",
                     help="SCENARIO conf key: a declarative chaos-schedule "
                          "JSON (crash/restart/leave/partition/link_flake/"
@@ -315,7 +331,8 @@ def main(argv=None) -> int:
                           resume=args.resume,
                           telemetry=args.telemetry,
                           telemetry_dir=args.telemetry_dir,
-                          scenario=args.scenario)
+                          scenario=args.scenario,
+                          mesh_shape=args.mesh_shape)
     except RunInterrupted as e:
         # Graceful SIGTERM/SIGINT: the chunked driver already barriered
         # the checkpoint writer and flushed timeline/runlog at the stop

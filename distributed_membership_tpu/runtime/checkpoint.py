@@ -94,6 +94,7 @@ _IDENTITY_EXCLUDE = frozenset(
      # per-tick math — a conf submitted to a fleet resumes bit-exactly
      # under a controller with different scheduling knobs (or none).
      "FLEET_PORT", "FLEET_MAX_CONCURRENCY", "FLEET_DIR", "FLEET_LINGER",
+     "FLEET_MIGRATE_ON", "FLEET_MIGRATE_MAX",
      # The watchdog (observability/watchdog.py) only OBSERVES host-side
      # artifacts (runlog, beacons, the published snapshot metadata) —
      # a resume may toggle it freely.
@@ -276,10 +277,15 @@ def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
 
     prev = load_manifest(ckpt_dir)
     history = []
+    reshard_chain = None
     if prev is not None and all(
             prev.get(k) == base[k] for k in base):
         history = [h for h in prev.get("checkpoints", ())
                    if h["tick"] < tick]
+        # Reshard provenance (elastic/reshard.py stamps it) must survive
+        # every later boundary write — the manifest is rebuilt from
+        # `base` each time, so carry the chain forward like the history.
+        reshard_chain = prev.get("reshard")
     history.append({"tick": int(tick), "file": fname, "state_hash": shash})
     for stale in history[:-KEEP_CHECKPOINTS]:
         try:
@@ -293,6 +299,8 @@ def _save_checkpoint(ckpt_dir: str, base: dict, tick: int,
         "checkpoints": history,
         "wrote_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     })
+    if reshard_chain:
+        manifest["reshard"] = reshard_chain
     def _write_manifest(tmp):
         with open(tmp, "w") as fh:
             json.dump(manifest, fh, indent=1)

@@ -75,9 +75,53 @@ def build_commands(args, port: int):
                     "--checkpoint-dir", os.path.join(pdir, "ckpt")]
         if args.resume:
             cmd += ["--resume"]
+        if args.mesh_shape:
+            cmd += ["--mesh-shape", args.mesh_shape]
         cmd += args.extra
         jobs.append((cmd, env, pdir))
     return jobs
+
+
+def maybe_reshard(args) -> int:
+    """Elastic resume (elastic/reshard.py): when ``--resume`` finds a
+    checkpoint written by a DIFFERENT process count or mesh shape,
+    redistribute it host-side before launching — so the very same
+    launcher command, edited only at ``--procs``/``--mesh-shape``,
+    migrates a run across geometries.  Returns a process count whose
+    checkpoints exist (the count to launch), or -1 on refusal."""
+    if not (args.resume and args.checkpoint_every):
+        return args.procs
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import json
+
+    from distributed_membership_tpu.elastic.reshard import (
+        ReshardError, reshard)
+    from distributed_membership_tpu.runtime.checkpoint import (
+        load_manifest)
+    out_root = os.path.abspath(args.out_root)
+    head = load_manifest(os.path.join(out_root, "p0", "ckpt"))
+    if head is None:
+        return args.procs               # fresh start: nothing to move
+    from_procs = int(head.get("process_count", 1))
+    from_shape = json.loads(head["params_text"]).get("MESH_SHAPE", "")
+    to_shape = args.mesh_shape or from_shape
+    if from_procs == args.procs and to_shape == from_shape:
+        return args.procs               # same geometry: plain resume
+    src = [os.path.join(out_root, f"p{i}", "ckpt")
+           for i in range(from_procs)]
+    dst = [os.path.join(out_root, f"p{i}", "ckpt")
+           for i in range(args.procs)]
+    try:
+        stats = reshard(src, dst, to_mesh_shape=to_shape or None)
+    except ReshardError as e:
+        print(f"[multiproc] reshard refused: {e}", file=sys.stderr)
+        return -1
+    print(f"[multiproc] resharded tick {stats['tick']}: "
+          f"{stats['from_shape'] or '(auto)'}/{stats['from_procs']}p -> "
+          f"{stats['to_shape'] or '(auto)'}/{stats['to_procs']}p "
+          f"in {stats['wall_seconds']:.2f}s")
+    return args.procs
 
 
 def main(argv=None) -> int:
@@ -95,6 +139,10 @@ def main(argv=None) -> int:
                     "size = procs x this)")
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--mesh-shape", default=None,
+                    help="MESH_SHAPE for every process; with --resume, "
+                    "a checkpoint from a different shape or --procs is "
+                    "resharded host-side first (elastic/reshard.py)")
     ap.add_argument("--timeout", type=float, default=None,
                     help="per-run wall clock limit in seconds")
     ap.add_argument("--merge", action="store_true",
@@ -118,6 +166,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     args.extra = args.extra + forwarded
 
+    if maybe_reshard(args) < 0:
+        return 2
     port = _free_port()
     jobs = build_commands(args, port)
     procs = []

@@ -264,6 +264,12 @@ def build_report(directory: str | None,
                      and r.get("tick_lag") is not None),
                     default=None),
             }
+        # Elastic-mesh provenance (elastic/reshard.py): a resharded
+        # run's checkpoint manifest carries the full migration chain —
+        # surface it so a report says WHERE this trajectory has lived.
+        chain = _reshard_chain(directory)
+        if chain:
+            report["reshard"] = chain
     if ladder_path and os.path.exists(ladder_path):
         report["ladder"] = _ladder_stats(read_events(ladder_path))
     # Reconciliation: the per-tick series must sum to the run verdicts
@@ -298,6 +304,23 @@ def build_report(directory: str | None,
     if slo and "h_latency" in series:
         report["slo"] = slo_verdict(series)
     return report
+
+
+def _reshard_chain(directory: str) -> list:
+    """The reshard-provenance chain from the run's checkpoint manifest
+    (first of the conventional checkpoint dir names under
+    ``directory``, plus a multiproc ``p0/``)."""
+    for sub in ("ck", "ckpt", "checkpoints",
+                os.path.join("p0", "ck"), os.path.join("p0", "ckpt")):
+        path = os.path.join(directory, sub, "MANIFEST.json")
+        try:
+            with open(path) as fh:
+                chain = json.load(fh).get("reshard")
+        except (OSError, ValueError):
+            continue
+        if chain:
+            return list(chain)
+    return []
 
 
 def compare_dirs(dir_a: str, dir_b: str) -> dict:
@@ -502,6 +525,18 @@ def render_markdown(report: dict) -> str:
                 f"{r.get('tick_lag', '-')} | "
                 f"{'stale' if r['stale'] else r.get('engine_status')} |")
         lines.append("")
+    rsh = report.get("reshard")
+    if rsh:
+        lines += ["## Elastic reshard provenance", "",
+                  "| tick | from shape/procs | to shape/procs | "
+                  "carry digest |", "|---|---|---|---|"]
+        for r in rsh:
+            lines.append(
+                f"| {r.get('tick')} | {r.get('from_shape') or '(auto)'}"
+                f"/{r.get('from_procs')}p | "
+                f"{r.get('to_shape') or '(auto)'}/{r.get('to_procs')}p "
+                f"| {str(r.get('carry_digest', ''))[:16]} |")
+        lines.append("")
     seg = report.get("segments")
     if seg:
         lines += ["## Segment timings (chunked driver)", "",
@@ -604,6 +639,18 @@ def fleet_report(root: str) -> dict:
             runs[rid]["state"] = row.get("state", runs[rid]["state"])
             runs[rid]["tick"] = int(row.get("tick",
                                             runs[rid]["tick"]))
+            # Migration provenance (elastic/migrate.py journals both
+            # transitions with trigger + from/resume ticks).
+            if row.get("state") == "migrating":
+                runs[rid]["migrations"] = (
+                    runs[rid].get("migrations", 0) + 1)
+                runs[rid]["last_trigger"] = row.get("trigger", "")
+            elif row.get("state") == "requeued":
+                ft, rt = row.get("from_tick"), row.get("resume_tick")
+                if ft is not None and rt is not None:
+                    runs[rid]["downtime_ticks"] = (
+                        runs[rid].get("downtime_ticks", 0)
+                        + max(int(ft) - int(rt), 0))
     rows = []
     for rid in sorted(runs, key=lambda r: runs[r]["seq"]):
         row = runs[rid]
@@ -718,6 +765,12 @@ def render_fleet(report: dict) -> str:
                    else r["query_lag"])
             line += (f"  query {r['query_qps']} q/s "
                      f"x{r['query_replicas']} lag {lag}")
+        if r.get("migrations"):
+            line += (f"  mig x{r['migrations']}"
+                     + (f" ({r['last_trigger']})"
+                        if r.get("last_trigger") else "")
+                     + (f" downtime {r['downtime_ticks']}t"
+                        if r.get("downtime_ticks") is not None else ""))
         if r.get("alerts"):
             line += f"  ALERTS {r['alerts']}"
         lines.append(line)

@@ -1,0 +1,812 @@
+"""Elastic mesh (elastic/ + fleet placement + migration policy).
+
+Pins the three legs of the elastic-mesh story at both granularities:
+
+  * host-side units (fast): reshard round-trips across geometry pairs
+    on synthetic checkpoints, every refusal names its violated bound,
+    the placement capacity model pins disjoint slices and refuses
+    loudly, migration transitions journal fsync-before-ACK with the
+    provenance the reporter renders, and the reap classifier adopts a
+    worker that died DURING a checkpoint write instead of failing it;
+  * end-to-end (slow-marked): the headline pin — a run killed mid-
+    flight and resumed at a different MESH_SHAPE produces artifacts
+    byte-identical to an unmigrated twin (plain and MEGA_TICKS +
+    batched-exchange arms) — plus death-triggered fleet failover with
+    the manual ``POST /v1/runs/<id>/migrate`` drain.
+"""
+
+import http.client
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from distributed_membership_tpu.elastic.migrate import (
+    DEFAULT_ALERT_RULES, MigratePolicy, alert_count, migrate_record)
+from distributed_membership_tpu.elastic.reshard import (
+    ReshardError, mesh_size, reshard, validate_geometry)
+from distributed_membership_tpu.elastic.reshard import main as reshard_main
+from distributed_membership_tpu.fleet.daemon import FleetState
+from distributed_membership_tpu.fleet.placement import (
+    DeviceSlice, HostCapacity, PlacementError)
+from distributed_membership_tpu.fleet.registry import FleetJournal, Registry
+from distributed_membership_tpu.fleet.registry import (
+    JOURNAL_NAME as FLEET_JOURNAL)
+from distributed_membership_tpu.fleet.scheduler import Scheduler
+from distributed_membership_tpu.runtime.checkpoint import (
+    CKPT_VERSION, CRASH_ENV, MANIFEST_NAME, load_manifest, state_hash)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# Same servable ring conf shape as test_fleet's.
+_HASH_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+              "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 1000\n"
+              "JOIN_MODE: warm\nBACKEND: tpu_hash\nEVENT_MODE: full\n"
+              "CHECKPOINT_EVERY: 30\nTELEMETRY: scalars\n")
+_EMUL_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+              "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 50\n"
+              "BACKEND: emul\nTOTAL_TIME: 150\n")
+
+
+def _hash_conf(total=120):
+    return _HASH_CONF + f"TOTAL_TIME: {total}\n"
+
+
+# ---------------------------------------------------------------------------
+# Synthetic checkpoints: the real on-disk format (runtime/checkpoint.py
+# manifest + npz carry) hand-built so the host-side reshard path is
+# covered without a backend run.
+
+
+def _write_ckpt(d, *, n=32, s=4, shape="8", procs=1, total=200,
+                tick=40, folded=0, seed=0):
+    rng = np.random.default_rng(7)
+    leaves = [
+        rng.random((n, s)) < 0.5,                          # bool plane
+        rng.integers(0, 100, (n, s)).astype(np.int32),     # fits16 lanes
+        rng.integers(0, 100, n).astype(np.int32),          # row vector
+        np.int32(tick),                                    # scalar leaf
+        rng.random((n,)).astype(np.float32),
+    ]
+    payload = {"e_hist": rng.random(5)}
+    params = {"EN_GPSZ": n, "VIEW_SIZE": s, "MESH_SHAPE": shape,
+              "FOLDED": folded, "BACKEND": "tpu_hash_sharded"}
+    fname = f"ckpt_{tick:08d}.npz"
+    manifest = {
+        "version": CKPT_VERSION, "tick": tick,
+        "state_hash": state_hash(leaves),
+        "params_text": json.dumps(params, sort_keys=True),
+        "seed": seed, "backend": "tpu_hash_sharded",
+        "total_time": total, "process_count": procs, "file": fname,
+        "checkpoints": [{"tick": tick, "file": fname}],
+    }
+    os.makedirs(d, exist_ok=True)
+    np.savez(os.path.join(d, fname),
+             **{f"c{i}": leaf for i, leaf in enumerate(leaves)},
+             **payload)
+    with open(os.path.join(d, MANIFEST_NAME), "w") as fh:
+        json.dump(manifest, fh)
+    return leaves, manifest
+
+
+def _read_arrays(d):
+    m = load_manifest(d)
+    with np.load(os.path.join(d, m["file"])) as npz:
+        return {k: npz[k] for k in npz.files}, m
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("src_geo,dst_geo,pack16", [
+    (("8", 1), ("4x2", 1), False),     # shape change, one process
+    (("8", 1), ("8", 2), False),       # process count change
+    (("2x4", 2), ("4x2", 1), False),   # both change, 2 source procs
+    (("4", 1), ("2x2", 1), True),      # pack16 codec arm
+])
+def test_reshard_roundtrip_geometries(tmp_path, src_geo, dst_geo, pack16):
+    """Reshard across geometry pairs: carry bit-identical, manifest
+    retargeted (MESH_SHAPE + process_count), provenance stamped."""
+    (from_shape, from_procs), (to_shape, to_procs) = src_geo, dst_geo
+    srcs = [str(tmp_path / f"s{i}") for i in range(from_procs)]
+    dsts = [str(tmp_path / f"d{i}") for i in range(to_procs)]
+    for d in srcs:
+        # Deterministic builder: every source dir holds one boundary.
+        leaves, _ = _write_ckpt(d, shape=from_shape, procs=from_procs)
+    stats = reshard(srcs, dsts, to_mesh_shape=to_shape, pack16=pack16)
+    assert stats["from_shape"] == from_shape
+    assert stats["to_shape"] == to_shape
+    assert stats["from_procs"] == from_procs
+    assert stats["to_procs"] == to_procs
+    assert stats["tick"] == 40
+    assert stats["carry_bytes_packed"] < stats["carry_bytes_full"]
+    assert stats["codec_seconds"] >= 0
+    for d in dsts:
+        arrays, m = _read_arrays(d)
+        assert int(m["process_count"]) == to_procs
+        assert json.loads(m["params_text"])["MESH_SHAPE"] == to_shape
+        for i, leaf in enumerate(leaves):
+            got = arrays[f"c{i}"]
+            assert got.dtype == np.asarray(leaf).dtype
+            assert np.array_equal(got, leaf)
+        assert "e_hist" in arrays
+        chain = m["reshard"]
+        assert len(chain) == 1 and chain[0]["from_shape"] == from_shape
+        assert chain[0]["carry_digest"] == m["state_hash"]
+
+
+@pytest.mark.quick
+def test_reshard_provenance_survives_chained_migrations(tmp_path):
+    d0, d1 = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_ckpt(d0, shape="8")
+    reshard([d0], [d1], to_mesh_shape="4x2")
+    reshard([d1], [d1], to_mesh_shape="2x2x2")
+    chain = load_manifest(d1)["reshard"]
+    assert [(r["from_shape"], r["to_shape"]) for r in chain] == [
+        ("8", "4x2"), ("4x2", "2x2x2")]
+    # Stale snapshots from the old topology were dropped on fan-out.
+    npzs = [f for f in os.listdir(d1) if f.endswith(".npz")]
+    assert npzs == [load_manifest(d1)["file"]]
+
+
+@pytest.mark.quick
+def test_reshard_refusals_name_the_violated_bound(tmp_path):
+    src = str(tmp_path / "src")
+    _write_ckpt(src, n=32, shape="8", total=200)
+
+    with pytest.raises(ReshardError, match="does not divide N=32"):
+        reshard([src], [str(tmp_path / "x")], to_mesh_shape="7")
+    with pytest.raises(ReshardError, match="does not divide across 3"):
+        reshard([src], [str(tmp_path / f"x{i}") for i in range(3)],
+                to_mesh_shape="8")
+    with pytest.raises(ReshardError, match="must be 'D', 'OxI'"):
+        reshard([src], [str(tmp_path / "x")], to_mesh_shape="4xx2")
+    with pytest.raises(ReshardError, match="nothing durable"):
+        reshard([str(tmp_path / "nope")], [str(tmp_path / "x")])
+    # PACK_SAFE_TICKS named when the static tick bound refuses pack16.
+    big = str(tmp_path / "big")
+    _write_ckpt(big, total=200_000)
+    with pytest.raises(ReshardError, match="PACK_SAFE_TICKS"):
+        reshard([big], [str(tmp_path / "x")], pack16=True)
+    # FOLDED needs an even per-device row count.
+    with pytest.raises(ReshardError, match="even per-device row count"):
+        validate_geometry(32, 100, "8", "32", 1, 1, folded=True)
+    # Every source process's directory must be presented.
+    two = str(tmp_path / "two")
+    _write_ckpt(two, procs=2)
+    with pytest.raises(ReshardError, match="every source"):
+        reshard([two], [str(tmp_path / "x")])
+    # Disagreeing sources are not one run's boundary.
+    othr = str(tmp_path / "othr")
+    _write_ckpt(two, procs=2)
+    _write_ckpt(othr, procs=2, tick=60)
+    with pytest.raises(ReshardError, match="disagree"):
+        reshard([two, othr], [str(tmp_path / "x")])
+    # Corruption behind the manifest's back fails the state-hash gate.
+    bad = str(tmp_path / "bad")
+    leaves, m = _write_ckpt(bad)
+    leaves[1][0, 0] += 1
+    np.savez(os.path.join(bad, m["file"]),
+             **{f"c{i}": leaf for i, leaf in enumerate(leaves)})
+    with pytest.raises(ReshardError, match="corrupt"):
+        reshard([bad], [str(tmp_path / "x")])
+
+
+@pytest.mark.quick
+def test_reshard_cli_roundtrip_and_refusal_rc2(tmp_path, capsys):
+    src, dst = str(tmp_path / "s"), str(tmp_path / "d")
+    _write_ckpt(src, shape="8")
+    assert reshard_main(["--src", src, "--dst", dst,
+                         "--mesh-shape", "4x2"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["to_shape"] == "4x2"
+    assert reshard_main(["--src", dst, "--dst", dst,
+                         "--mesh-shape", "7"]) == 2
+    assert "does not divide N=32" in capsys.readouterr().out
+
+
+@pytest.mark.quick
+def test_mesh_size_and_grammar():
+    assert mesh_size("") == 1 and mesh_size("", default=4) == 4
+    assert mesh_size("8") == 8 and mesh_size("2x4") == 8
+    assert mesh_size("2x2x2") == 8
+    with pytest.raises(ReshardError, match="source MESH_SHAPE"):
+        validate_geometry(32, 100, "x8", "8", 1, 1)
+    with pytest.raises(ReshardError, match=">= 1"):
+        validate_geometry(32, 100, "8", "8", 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Launcher wiring: the same multiproc command edited at --procs /
+# --mesh-shape reshards the durable checkpoint before relaunching.
+
+
+@pytest.mark.quick
+def test_multiproc_maybe_reshard(tmp_path, capsys):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import multiproc_launch
+
+    root = str(tmp_path)
+    _write_ckpt(os.path.join(root, "p0", "ckpt"), shape="8", procs=1)
+
+    def _args(**kw):
+        base = dict(resume=True, checkpoint_every=20, out_root=root,
+                    procs=1, mesh_shape=None)
+        base.update(kw)
+        return types.SimpleNamespace(**base)
+
+    # Not a resume -> untouched; same geometry -> plain resume.
+    assert multiproc_launch.maybe_reshard(_args(resume=False)) == 1
+    assert multiproc_launch.maybe_reshard(_args()) == 1
+    assert load_manifest(os.path.join(root, "p0", "ckpt")).get(
+        "reshard") is None
+    # 1 -> 2 processes at a new shape: both per-process dirs rewritten.
+    assert multiproc_launch.maybe_reshard(
+        _args(procs=2, mesh_shape="4x2")) == 2
+    assert "resharded tick 40" in capsys.readouterr().out
+    for i in range(2):
+        m = load_manifest(os.path.join(root, f"p{i}", "ckpt"))
+        assert m["process_count"] == 2
+        assert json.loads(m["params_text"])["MESH_SHAPE"] == "4x2"
+    # Refusal propagates as -1 (launcher exits 2), checkpoint untouched.
+    assert multiproc_launch.maybe_reshard(
+        _args(procs=2, mesh_shape="7")) == -1
+    assert "reshard refused" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Placement capacity model.
+
+
+@pytest.mark.quick
+def test_placement_slices_disjoint_and_best_fit():
+    cap = HostCapacity(cores=8, slices=(
+        DeviceSlice("big", 8, mesh_shape="4x2"),
+        DeviceSlice("small", 4, mesh_shape="2x2")))
+    p = cap.place("a", sharded=True, devices=2)
+    assert p.slice_name == "small"      # best fit: smallest that fits
+    assert p.mesh_shape == "2x2"
+    assert cap.place("a", sharded=True, devices=2) is p   # idempotent
+    q = cap.place("b", sharded=True, devices=8)
+    assert q.slice_name == "big"
+    # Both slices held: the refusal names the holders.
+    with pytest.raises(PlacementError) as ei:
+        cap.place("c", sharded=True, devices=1)
+    assert "'a'" in str(ei.value) or "a" in str(ei.value)
+    assert "disjoint slices" in str(ei.value)
+    cap.release("a")
+    assert cap.place("c", sharded=True, devices=1).slice_name == "small"
+    assert cap.summary()["slices"][0]["held_by"] == "b"
+
+
+@pytest.mark.quick
+def test_placement_core_packing_never_oversubscribes():
+    cap = HostCapacity(cores=4)
+    cap.place("a", cores=2)
+    cap.place("b", cores=2)
+    with pytest.raises(PlacementError, match="capacity exhausted"):
+        cap.place("c", cores=1)
+    cap.release("a")
+    assert cap.place("c", cores=2).cores == 2
+    assert cap.cores_used() == 4
+    # A sharded run on a no-slice host is a loud refusal, not a hang.
+    with pytest.raises(PlacementError, match="no free device slice"):
+        cap.place("d", sharded=True, devices=1)
+    local = HostCapacity.local(devices=8, slice_devices=4)
+    assert [s.devices for s in local.slices] == [4, 4]
+
+
+# ---------------------------------------------------------------------------
+# Migration policy + journaled transitions.
+
+
+@pytest.mark.quick
+def test_migrate_policy_parse_and_triggers(tmp_path):
+    pol = MigratePolicy.from_conf("death, alerts", 3)
+    assert pol.on_death and pol.max_migrations == 3
+    assert not MigratePolicy.from_conf("").triggers
+    with pytest.raises(ValueError, match="unknown trigger.*'teleport'"):
+        MigratePolicy.from_conf("death,teleport")
+    with pytest.raises(ValueError, match="FLEET_MIGRATE_MAX"):
+        MigratePolicy.from_conf("death", -1)
+
+    run_dir = str(tmp_path)
+    now = time.time()
+    with open(os.path.join(run_dir, "runlog.jsonl"), "w") as fh:
+        fh.write(json.dumps({"kind": "alert", "rule": "tick_rate_collapse",
+                             "ts": now - 100}) + "\n")
+        fh.write('{"torn line\n')
+        fh.write(json.dumps({"kind": "alert", "rule": "qps_dip",
+                             "ts": now}) + "\n")
+    assert alert_count(run_dir, DEFAULT_ALERT_RULES, since=0.0) == 1
+    # The since-filter: rows from a previous incarnation never
+    # re-trigger a fresh worker.
+    pol = MigratePolicy.from_conf("alerts")
+    assert pol.sick_trigger(run_dir=run_dir, beacon=None, total=100,
+                            started_wall=now - 50) is None
+    assert pol.sick_trigger(run_dir=run_dir, beacon=None, total=100,
+                            started_wall=now - 200) == "alerts"
+
+    pol = MigratePolicy.from_conf("stale-beacon")
+    stale = {"tick": 10, "ts": now - 100}
+    assert pol.sick_trigger(run_dir=run_dir, beacon=stale, total=100,
+                            started_wall=0.0) == "stale-beacon"
+    fresh = {"tick": 10, "ts": now}
+    assert pol.sick_trigger(run_dir=run_dir, beacon=fresh, total=100,
+                            started_wall=0.0) is None
+    finished = {"tick": 100, "ts": now - 100}     # done, just not reaped
+    assert pol.sick_trigger(run_dir=run_dir, beacon=finished, total=100,
+                            started_wall=0.0) is None
+
+
+@pytest.mark.quick
+def test_migrate_record_journals_fsync_before_ack(tmp_path):
+    root = str(tmp_path)
+    reg = Registry(root)
+    rec = reg.submit(_hash_conf(), run_id="mig")
+    rec.tick = 40                        # durable manifest tick
+    detail = migrate_record(reg, rec, "death", from_tick=55)
+    assert detail == {"trigger": "death", "from_tick": 55,
+                      "resume_tick": 40, "downtime_ticks": 15}
+    assert rec.state == "requeued" and rec.migrations == 1
+    assert rec.last_trigger == "death"
+    rows = FleetJournal(os.path.join(root, FLEET_JOURNAL)).read()
+    kinds = [(r["kind"], r.get("state")) for r in rows]
+    assert kinds == [("submit", None), ("state", "migrating"),
+                     ("state", "requeued")]
+    assert rows[1]["trigger"] == "death" and rows[1]["from_tick"] == 55
+    assert rows[2]["resume_tick"] == 40
+    # Manual drains are exempt from the FLEET_MIGRATE_MAX counter.
+    migrate_record(reg, rec, "manual")
+    assert rec.migrations == 1 and rec.last_trigger == "manual"
+    # Recovery replays the journal: the count survives a controller
+    # crash and the run is dispatchable again.
+    reg2 = Registry(root)
+    reg2.recover()
+    rec2 = reg2.runs["mig"]
+    assert rec2.migrations == 1
+    assert rec2.run_id in [r.run_id for r in reg2.queued()]
+    assert not rec2.migrate_requested
+
+
+@pytest.mark.quick
+def test_classify_adopts_death_during_checkpoint_write(tmp_path):
+    """A worker that died mid-checkpoint-write still left a COMPLETE
+    durable boundary (the manifest only names atomically-renamed
+    snapshots) — the reaper must classify it ``checkpointed``, not
+    ``failed``, so failover resumes instead of restarting."""
+    root = str(tmp_path)
+    reg = Registry(root)
+    rec = reg.submit(_hash_conf(), run_id="w")
+    sched = Scheduler(reg, 1, threading.Lock())     # never started
+    # Crash rc, no durable boundary: genuinely failed.
+    assert sched._classify(rec, rc=1) == "failed"
+    ck = rec.ckpt_dir(root)
+    os.makedirs(ck)
+    with open(os.path.join(ck, MANIFEST_NAME), "w") as fh:
+        json.dump({"tick": 60}, fh)
+    assert sched._classify(rec, rc=1) == "checkpointed"
+    assert rec.tick == 60               # refreshed from the manifest
+    rec.killing = True
+    assert sched._classify(rec, rc=1) == "killed"
+
+
+@pytest.mark.quick
+def test_migrate_now_enforces_cap_except_manual(tmp_path):
+    root = str(tmp_path)
+    reg = Registry(root)
+    rec = reg.submit(_hash_conf(), run_id="capped")
+    pol = MigratePolicy.from_conf("death", 1)
+    sched = Scheduler(reg, 1, threading.Lock(), policy=pol)
+    rec.state = "failed"
+    rec.migrations = 1                  # cap already spent
+    sched._migrate_now(rec, "death", 50)
+    assert rec.state == "failed"        # terminal state stands
+    sched._migrate_now(rec, "manual", 50)
+    assert rec.state == "requeued"      # operators are never capped
+
+
+@pytest.mark.quick
+def test_manual_migrate_verb(tmp_path):
+    root = str(tmp_path)
+    reg = Registry(root)
+    lock = threading.Lock()
+    sched = Scheduler(reg, 1, lock)     # never started
+    state = FleetState(reg, sched, lock)
+
+    parked = reg.submit(_hash_conf(), run_id="parked")
+    reg.set_state(parked, "checkpointed", tick=60)
+    code, body = state.verb("parked", "migrate")
+    assert code == 202 and body["state"] == "requeued"
+    assert body["trigger"] == "manual"
+    assert parked.migrations == 0       # manual: cap untouched
+
+    queued = reg.submit(_hash_conf(), run_id="queued")
+    code, body = state.verb("queued", "migrate")
+    assert code == 409 and "queued" in body["error"]
+
+    headless = reg.submit(_EMUL_CONF, run_id="headless")
+    reg.set_state(headless, "running")
+    code, body = state.verb("headless", "migrate")
+    assert code == 409 and "no chunked driver" in body["error"]
+
+    ghost = reg.submit(_hash_conf(), run_id="ghost")
+    reg.set_state(ghost, "running")     # journaled, but no worker
+    code, body = state.verb("ghost", "migrate")
+    assert code == 409 and "not signallable" in body["error"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos fuzzer: migrate is an opt-in event kind (mix=), never in
+# DEFAULT_MIX (it would shift every pinned campaign digest).
+
+
+@pytest.mark.quick
+def test_fuzz_migrate_event_optin():
+    from distributed_membership_tpu.chaos.fuzz import (
+        DEFAULT_MIX, CampaignSpec, fuzz_schedule)
+    assert "migrate" not in DEFAULT_MIX
+    default = fuzz_schedule(CampaignSpec(), 0)
+    assert all(e["kind"] != "migrate" for e in default["events"])
+    spec = CampaignSpec(seed=5, n=16, events=4, total=160,
+                        mix={"crash": 1.0, "migrate": 1.0})
+    sch = fuzz_schedule(spec, 0)
+    mig = [e for e in sch["events"] if e["kind"] == "migrate"]
+    assert mig and all(0 < e["time"] < spec.total for e in mig)
+    # Deterministic: same (spec, index) -> same schedule.
+    assert fuzz_schedule(spec, 0) == sch
+
+
+# ---------------------------------------------------------------------------
+# Provenance surfaces: perf ledger rung lift + run/fleet reports.
+
+
+@pytest.mark.quick
+def test_perfdb_reshard_rung_lift():
+    from distributed_membership_tpu.observability import perfdb
+    row = perfdb.make_row("bench:live:hash:elastic",
+                          metric="reshard_wall_seconds", value=1.5,
+                          higher_is_better=False, knobs={"reshard": 1})
+    assert row["rung"] == "bench:live:hash:elastic:reshard"
+    row = perfdb.make_row("bench:live:hash:elastic",
+                          metric="resume_wall_seconds", value=1.0,
+                          higher_is_better=False, knobs={})
+    assert not row["rung"].endswith(":reshard")
+
+
+@pytest.mark.quick
+def test_run_report_reshard_provenance_rows(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+
+    d = tmp_path / "run"
+    (d / "ck").mkdir(parents=True)
+    chain = [{"from_shape": "8", "to_shape": "4x2", "from_procs": 1,
+              "to_procs": 1, "carry_digest": "ab" * 32, "tick": 40,
+              "ts": "2026-08-07T00:00:00Z"}]
+    with open(d / "ck" / "MANIFEST.json", "w") as fh:
+        json.dump({"tick": 40, "reshard": chain}, fh)
+    assert run_report._reshard_chain(str(d)) == chain
+    report = run_report.build_report(str(d))
+    assert report["reshard"] == chain
+    md = run_report.render_markdown(report)
+    assert "Elastic reshard provenance" in md
+    assert "4x2" in md and ("ab" * 8) in md     # digest truncated
+
+
+@pytest.mark.quick
+def test_fleet_report_migration_rows(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+
+    root = str(tmp_path)
+    rows = [
+        {"kind": "submit", "run_id": "m", "conf": _hash_conf(150),
+         "seq": 1},
+        {"kind": "state", "run_id": "m", "state": "running"},
+        {"kind": "state", "run_id": "m", "state": "migrating",
+         "trigger": "death", "from_tick": 55, "tick": 40},
+        {"kind": "state", "run_id": "m", "state": "requeued",
+         "trigger": "death", "from_tick": 55, "resume_tick": 40,
+         "tick": 40},
+        {"kind": "state", "run_id": "m", "state": "running",
+         "tick": 40},
+    ]
+    with open(os.path.join(root, "fleet_runs.jsonl"), "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    report = run_report.fleet_report(root)
+    (row,) = report["runs"]
+    assert row["migrations"] == 1 and row["last_trigger"] == "death"
+    assert row["downtime_ticks"] == 15
+    text = run_report.render_fleet(report)
+    assert "mig x1 (death) downtime 15t" in text
+
+
+# ---------------------------------------------------------------------------
+# End-to-end (slow): the headline byte-identity pin and fleet failover.
+
+
+def _env(devices=8):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(REPO) + os.pathsep +
+                         env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    return env
+
+
+_SHARD_CONF = ("MAX_NNB: 16\nSINGLE_FAILURE: 1\nDROP_MSG: 0\n"
+               "MSG_DROP_PROB: 0.0\nVIEW_SIZE: 8\nFAIL_TIME: 30\n"
+               "JOIN_MODE: warm\nBACKEND: tpu_hash_sharded\n"
+               "EVENT_MODE: full\nEN_GPSZ: 32\nTOTAL_TIME: 60\n")
+
+
+def _run_cli(conf_path, out_dir, *extra, crash_at=None, check=True):
+    env = _env()
+    if crash_at is not None:
+        env[CRASH_ENV] = str(crash_at)
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_membership_tpu",
+         str(conf_path), "--out-dir", str(out_dir), "--seed", "3",
+         *extra],
+        env=env, capture_output=True, text=True, timeout=600)
+    if check and crash_at is None:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def _bytes(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def _byte_identity_arm(tmp_path, conf_text, telemetry=False):
+    """Kill at mesh '8' mid-run, reshard to 4x2, resume; the artifacts
+    must be byte-identical to an unmigrated 4x2 twin's."""
+    conf = tmp_path / "run.conf"
+    conf.write_text(conf_text + "MESH_SHAPE: 8\n")
+    mig, twin = tmp_path / "mig", tmp_path / "twin"
+    mig.mkdir(), twin.mkdir()
+
+    def _tele(d):
+        return ("--telemetry-dir", str(d)) if telemetry else ()
+
+    ck = mig / "ck"
+    ckargs = ("--checkpoint-every", "20", "--checkpoint-dir", str(ck),
+              "--resume")
+    proc = _run_cli(conf, mig, *ckargs, *_tele(mig), crash_at=30)
+    assert proc.returncode != 0 and "injected crash" in (
+        proc.stdout + proc.stderr)
+    m = load_manifest(str(ck))
+    assert m is not None and m["tick"] >= 30
+
+    stats = reshard([str(ck)], [str(ck)], to_mesh_shape="4x2")
+    assert stats["from_shape"] == "8" and stats["to_shape"] == "4x2"
+    _run_cli(conf, mig, *ckargs, "--mesh-shape", "4x2", *_tele(mig))
+
+    # The twin runs chunked at the same segment length: MEGA_TICKS
+    # refuses the monolithic scan, and chunked-vs-monolithic identity
+    # is pinned elsewhere (test_checkpoint) — this arm pins
+    # migrated-vs-unmigrated only.
+    _run_cli(conf, twin, "--mesh-shape", "4x2", "--checkpoint-every",
+             "20", "--checkpoint-dir", str(twin / "ck"), *_tele(twin))
+    for name in ("dbg.log", "stats.log"):
+        assert _bytes(mig / name) == _bytes(twin / name), name
+    return mig, twin
+
+
+@pytest.mark.slow
+def test_reshard_resume_byte_identical(tmp_path):
+    _byte_identity_arm(tmp_path, _SHARD_CONF)
+
+
+@pytest.mark.slow
+def test_reshard_resume_byte_identical_mega_batched(tmp_path):
+    """The headline arm: multi-tick residency (MEGA_TICKS) + batched
+    exchange + timeline telemetry survive a mid-flight migration
+    bit-exactly."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import run_report
+
+    conf = (_SHARD_CONF + "MEGA_TICKS: 10\nEXCHANGE_MODE: batched\n"
+            "TELEMETRY: scalars\n")
+    mig, twin = _byte_identity_arm(tmp_path, conf, telemetry=True)
+    cmp = run_report.compare_dirs(str(mig), str(twin))
+    assert cmp["identical"], cmp
+
+
+@pytest.mark.slow
+def test_campaign_migrate_inproc(tmp_path):
+    """A chaos campaign with migrate in the mix executes real kill +
+    reshard + resume cycles and still grades green (chunked resume is
+    byte-exact, so the oracle sees the migration-free trajectory)."""
+    from distributed_membership_tpu.chaos.campaign import run_campaign
+    from distributed_membership_tpu.chaos.fuzz import CampaignSpec
+    # one_way_flake keeps the STRIPPED engine schedule general-shaped
+    # (a lone crash would lower to the legacy plan with no oracle
+    # report — same contract as the non-migrating inproc path).
+    spec = CampaignSpec(seed=9, n=10, events=3, total=160, schedules=1,
+                        mix={"crash": 1.0, "one_way_flake": 1.0,
+                             "migrate": 1.0})
+    summary = run_campaign(spec, str(tmp_path), mode="inproc",
+                           shrink=False)
+    assert summary["ok"], summary
+    # The migrate cycle left its provenance chain on the side ckpt.
+    chains = []
+    scen = tmp_path / "scenarios"
+    for name in os.listdir(scen):
+        if name.endswith(".ckpt"):
+            m = load_manifest(str(scen / name))
+            if m:
+                chains.extend(m.get("reshard", ()))
+    assert chains, "migrate cycle never resharded a durable boundary"
+    assert all(c["from_shape"] == c["to_shape"] for c in chains)
+
+
+def _req(port, method, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=None if body is None else json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _listing(port):
+    code, raw = _req(port, "GET", "/v1/runs")
+    assert code == 200
+    return {r["run_id"]: r for r in json.loads(raw)["runs"]}
+
+
+def _wait(port, pred, timeout=300, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        runs = _listing(port)
+        if pred(runs):
+            return runs
+        time.sleep(0.1)
+    raise TimeoutError(f"{what} never held: {runs}")
+
+
+def _wait_boundary(root, run_id, *, tick=30, timeout=300):
+    """Poll the run's checkpoint manifest ON DISK (1 ms cadence) for a
+    durable boundary at >= tick.  The 100 ms HTTP listing poll is too
+    coarse: a warm chunked run can finish its whole remainder between
+    two listings, and the kill below must land mid-flight."""
+    ck = os.path.join(root, run_id, "ck")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        m = load_manifest(ck)
+        if m is not None and int(m["tick"]) >= tick:
+            return int(m["tick"])
+        time.sleep(0.001)
+    raise TimeoutError(f"{run_id} never wrote a tick>={tick} boundary")
+
+
+def _worker_pids(root):
+    marker = os.path.abspath(root) + os.sep
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmd = fh.read().decode(errors="replace")
+        except OSError:
+            continue
+        if marker in cmd and "run.conf" in cmd:
+            pids.append(int(pid))
+    return pids
+
+
+def _start_fleet(root, migrate_on="", max_concurrency=2):
+    conf = os.path.join(root, "fleet.conf")
+    with open(conf, "w") as fh:
+        fh.write(f"FLEET_MAX_CONCURRENCY: {max_concurrency}\n"
+                 f"FLEET_MIGRATE_ON: {migrate_on}\n")
+    log = open(os.path.join(root, "controller.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_membership_tpu", conf,
+         "--fleet", "--out-dir", root],
+        env=_env(), stdout=log, stderr=subprocess.STDOUT)
+    log.close()
+    deadline = time.monotonic() + 60
+    path = os.path.join(root, "fleet.json")
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "controller died: "
+                + open(os.path.join(root, "controller.log")).read())
+        try:
+            info = json.load(open(path))
+            if info.get("pid") == proc.pid:
+                return proc, info["port"]
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(0.05)
+    raise TimeoutError("controller never published fleet.json")
+
+
+def _stop_fleet(proc, port):
+    try:
+        _req(port, "POST", "/v1/admin/shutdown")
+    except OSError:
+        pass
+    proc.wait(timeout=60)
+
+
+@pytest.mark.slow
+def test_fleet_death_migration_e2e(tmp_path):
+    """FLEET_MIGRATE_ON: death — SIGKILL a worker past its first
+    durable boundary and the fleet journals migrating -> requeued
+    (trigger=death), relaunches, and the finished run's dbg.log is
+    byte-identical to an unkilled twin's.  Then the manual drain:
+    POST /v1/runs/<id>/migrate parks a RUNNING run at a boundary and
+    requeues it (trigger=manual, cap-exempt)."""
+    root = str(tmp_path)
+    proc, port = _start_fleet(root, migrate_on="death")
+    try:
+        conf = _hash_conf(150)
+        code, raw = _req(port, "POST", "/v1/runs",
+                         body={"conf": conf, "run_id": "twin", "seed": 3})
+        assert code == 202, raw
+        _wait(port, lambda r: r["twin"]["state"] == "done",
+              what="twin done")
+
+        code, raw = _req(port, "POST", "/v1/runs",
+                         body={"conf": conf, "run_id": "vic", "seed": 3})
+        assert code == 202, raw
+        _wait(port, lambda r: r["vic"]["state"] == "running",
+              what="vic running")
+        _wait_boundary(root, "vic")
+        (pid,) = _worker_pids(root)
+        os.kill(pid, signal.SIGKILL)
+
+        runs = _wait(port, lambda r: r["vic"]["state"] == "done",
+                     what="vic migrated + finished")
+        assert runs["vic"].get("migrations") == 1
+        assert runs["vic"].get("last_trigger") == "death"
+        rows = [json.loads(line) for line in
+                open(os.path.join(root, "fleet_runs.jsonl"))
+                if '"vic"' in line]
+        trans = [(r.get("state"), r.get("trigger")) for r in rows
+                 if r.get("kind") == "state"]
+        assert ("migrating", "death") in trans
+        assert ("requeued", "death") in trans
+        req = next(r for r in rows if r.get("state") == "requeued")
+        assert req["resume_tick"] >= 30    # resumed from the boundary
+        assert _bytes(os.path.join(root, "vic", "dbg.log")) == \
+            _bytes(os.path.join(root, "twin", "dbg.log"))
+
+        # Manual drain of a running run.
+        code, raw = _req(port, "POST", "/v1/runs",
+                         body={"conf": conf, "run_id": "man", "seed": 3})
+        assert code == 202, raw
+        _wait(port, lambda r: r["man"]["state"] == "running",
+              what="man running")
+        _wait_boundary(root, "man")
+        code, raw = _req(port, "POST", "/v1/runs/man/migrate")
+        assert code == 202, raw
+        runs = _wait(port, lambda r: r["man"]["state"] == "done",
+                     what="man drained + finished")
+        assert runs["man"].get("migrations") is None   # manual: exempt
+        assert runs["man"].get("last_trigger") == "manual"
+        assert _bytes(os.path.join(root, "man", "dbg.log")) == \
+            _bytes(os.path.join(root, "twin", "dbg.log"))
+    finally:
+        _stop_fleet(proc, port)
